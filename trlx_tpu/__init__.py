@@ -1,0 +1,21 @@
+"""trlx_tpu — a TPU-native (JAX/XLA/pjit/Pallas) RLHF framework.
+
+Capability-equivalent to trlx v0.2.0 (reference: /root/reference), redesigned
+TPU-first: functional Flax models over a `jax.sharding.Mesh`, single pjit'd
+train steps, `lax.scan`/`lax.while_loop` control flow, Pallas kernels for hot
+ops, and XLA collectives (psum/all_gather/ppermute) over ICI/DCN instead of
+NCCL/DeepSpeed.
+
+Public API mirrors the reference's single entry point
+(reference: trlx/__init__.py:1, trlx/trlx.py:13-93):
+
+    import trlx_tpu
+    trlx_tpu.train("gpt2", reward_fn=...)          # online PPO
+    trlx_tpu.train("gpt2", dataset=(samples, rs))  # offline ILQL
+"""
+
+from trlx_tpu.trlx import train
+
+__version__ = "0.1.0"
+
+__all__ = ["train", "__version__"]

@@ -1,0 +1,159 @@
+"""Data elements passed between pipeline, orchestrator, and trainer layers.
+
+TPU-first redesign of the reference's torchtyping dataclasses
+(reference: trlx/data/__init__.py, trlx/data/ppo_types.py,
+trlx/data/ilql_types.py, trlx/data/accelerate_base_datatypes.py).
+
+Numeric batch dataclasses (PPORLBatch, ILQLBatch, ...) are registered as JAX
+pytrees, so whole batches cross the jit boundary and are donated/sharded as
+single pytrees. Host-side elements carrying strings (PromptElement,
+GeneralElement) are deliberately NOT pytrees. Shapes are STATIC per batch
+(padded to fixed lengths) — XLA requires static shapes; ragged data is padded
++ masked instead of dynamically `pad_sequence`-ed per batch like the reference
+(reference: trlx/pipeline/ppo_pipeline.py:39-66).
+"""
+
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Iterable
+
+import jax
+
+
+def _register_pytree(cls):
+    """Register a dataclass as a pytree node (fields are children, in order)."""
+    names = [f.name for f in fields(cls)]
+
+    def flatten(obj):
+        return [getattr(obj, n) for n in names], None
+
+    def unflatten(_, children):
+        return cls(*children)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+@dataclass
+class GeneralElement:
+    """Generic datum, host-side (reference: trlx/data/__init__.py:8-14)."""
+
+    data: Any
+    meta: Any = None
+
+
+@_register_pytree
+@dataclass
+class RLElement:
+    """State/action/reward triple (reference: trlx/data/__init__.py:28-37)."""
+
+    state: Any = None
+    action: Any = None
+    reward: float = 0.0
+
+
+@_register_pytree
+@dataclass
+class BatchElement:
+    """Tokens + attention mask (reference: trlx/data/__init__.py:39-47)."""
+
+    tokens: Any
+    masks: Any
+
+
+@dataclass
+class PromptElement:
+    """A single tokenized prompt, host-side (strings are not JAX types)
+    (reference: trlx/data/accelerate_base_datatypes.py:7-20)."""
+
+    text: str
+    tokens: Any
+
+
+@dataclass
+class PromptBatch:
+    """Batch of tokenized prompts, host-side
+    (reference: trlx/data/accelerate_base_datatypes.py:23-36)."""
+
+    text: Iterable[str]
+    tokens: Any
+
+
+@_register_pytree
+@dataclass
+class PPORLElement:
+    """One PPO rollout: query/response tokens + per-token logprobs, values,
+    KL-penalized rewards (reference: trlx/data/ppo_types.py:6-29; logprobs are
+    per-token as produced at trlx/orchestrator/ppo_orchestrator.py:90, not
+    vocab-sized as the reference docstring wrongly claims)."""
+
+    query_tensor: Any
+    response_tensor: Any
+    logprobs: Any
+    values: Any
+    rewards: Any
+
+
+@_register_pytree
+@dataclass
+class PPORLBatch:
+    """Batched PPO rollouts, fixed padded shapes
+    (reference: trlx/data/ppo_types.py:32-57).
+
+    query_tensors:    [batch, query_len]   (left-padded)
+    response_tensors: [batch, response_len] (right-padded)
+    logprobs/values/rewards: [batch, response_len]
+    response_mask:    [batch, response_len] — 1 where a real response token.
+       TPU addition: explicit mask instead of runtime pad-id comparisons, so
+       loss masking is shape-static and fusable.
+    """
+
+    query_tensors: Any
+    response_tensors: Any
+    logprobs: Any
+    values: Any
+    rewards: Any
+    response_mask: Any = None
+
+
+@_register_pytree
+@dataclass
+class ILQLElement:
+    """One offline ILQL sample (reference: trlx/data/ilql_types.py:6-27)."""
+
+    input_ids: Any
+    attention_mask: Any
+    rewards: Any
+    states_ixs: Any
+    actions_ixs: Any
+    dones: Any
+
+
+@_register_pytree
+@dataclass
+class ILQLBatch:
+    """Batched ILQL data (reference: trlx/data/ilql_types.py:30-49)."""
+
+    input_ids: Any
+    attention_mask: Any
+    rewards: Any
+    states_ixs: Any
+    actions_ixs: Any
+    dones: Any
+
+
+RewardFn = Callable[[Iterable[str]], Iterable[float]]
+MetricFn = Callable[[Iterable[str]], dict]
+
+__all__ = [
+    "GeneralElement",
+    "RLElement",
+    "BatchElement",
+    "PromptElement",
+    "PromptBatch",
+    "PPORLElement",
+    "PPORLBatch",
+    "ILQLElement",
+    "ILQLBatch",
+    "RewardFn",
+    "MetricFn",
+]

@@ -1,0 +1,136 @@
+"""Top-level config system: YAML → nested dataclasses.
+
+Mirrors the reference's three-section config (model/train/method —
+reference: trlx/data/configs.py:126-140) and flattening ``to_dict``
+(reference: trlx/data/configs.py:142-149), with TPU-first extensions:
+
+- ``ModelConfig`` carries compute/param dtypes, remat policy, and a
+  from-scratch architecture dict (so toy models need no checkpoint).
+- ``TrainConfig`` carries the mesh shape (dp/fsdp/tp/sp axis sizes) — the
+  explicit replacement for the Accelerate/DeepSpeed runtime the reference
+  delegates to (reference: trlx/model/accelerate_base_model.py:31).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import yaml
+
+from trlx_tpu.data.method_configs import MethodConfig, get_method
+
+
+@dataclass
+class ModelConfig:
+    """Model architecture + loading (reference: trlx/data/configs.py:24-44).
+
+    :param model_path: HF checkpoint name/path, or "" for from-scratch.
+    :param tokenizer_path: tokenizer name/path; "" → tensor-prompt mode
+        (no tokenizer, like examples/randomwalks.py in the reference).
+    :param model_type: registered trainer name (e.g. "ppo", "ilql").
+    :param num_layers_unfrozen: how many top transformer blocks train; the
+        rest are frozen via optax update masking (the functional analogue of
+        reference trlx/model/accelerate_base_model.py:49-64's requires_grad_).
+    :param model_arch: from-scratch architecture overrides (n_layer, n_head,
+        d_model, vocab_size, ...) — see trlx_tpu.models.lm.LMConfig.
+    :param dtype: compute dtype ("bfloat16" on TPU; MXU-native).
+    :param param_dtype: parameter storage dtype ("float32" master params).
+    :param remat: rematerialize transformer blocks (trade FLOPs for HBM).
+    """
+
+    model_path: str
+    tokenizer_path: str = ""
+    model_type: str = "ppo"
+    num_layers_unfrozen: int = -1
+    model_arch: Dict[str, Any] = field(default_factory=dict)
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = False
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
+class TrainConfig:
+    """Training loop + runtime config (reference: trlx/data/configs.py:47-123).
+
+    Reference fields kept 1:1 (total_steps..seed); TPU-native additions:
+
+    :param mesh: axis sizes (dp, fsdp, tp, sp). -1 on one axis = "fill with
+        remaining devices". Replaces WORLD_SIZE/accelerate config.
+    :param seq_length: max total tokens (prompt + generation). STATIC under
+        XLA: prompts are left-padded to ``seq_length - gen_length``.
+    :param loss_dtype: dtype losses/logits softmax run in (fp32 for stability).
+    """
+
+    total_steps: int
+    seq_length: int
+    epochs: int
+    batch_size: int
+
+    lr_ramp_steps: int
+    lr_decay_steps: int
+    weight_decay: float
+    learning_rate_init: float
+    learning_rate_target: float
+    opt_betas: Tuple[float, float] = (0.9, 0.95)
+
+    checkpoint_interval: int = 1000
+    eval_interval: int = 100
+
+    pipeline: str = "PromptPipeline"
+    orchestrator: str = "PPOOrchestrator"
+
+    project_name: str = "trlx_tpu"
+    entity_name: Optional[str] = None
+    checkpoint_dir: str = "ckpts"
+    seed: int = 1000
+
+    # --- TPU-native additions ---
+    mesh: Tuple[int, int, int, int] = (-1, 1, 1, 1)  # (dp, fsdp, tp, sp)
+    loss_dtype: str = "float32"
+    grad_clip: float = 1.0
+    resume_from_checkpoint: bool = False
+    async_checkpointing: bool = True
+    profile_dir: Optional[str] = None  # jax.profiler trace output, if set
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        cfg = dict(config)
+        if "opt_betas" in cfg:
+            cfg["opt_betas"] = tuple(cfg["opt_betas"])
+        if "mesh" in cfg:
+            cfg["mesh"] = tuple(cfg["mesh"])
+        return cls(**cfg)
+
+
+@dataclass
+class TRLConfig:
+    """Aggregate config (reference: trlx/data/configs.py:112-149)."""
+
+    model: ModelConfig
+    train: TrainConfig
+    method: MethodConfig
+
+    @classmethod
+    def load_yaml(cls, yml_fp: str):
+        """Load config from YAML (reference: trlx/data/configs.py:126-140)."""
+        with open(yml_fp, mode="r") as file:
+            config = yaml.safe_load(file)
+        return cls.from_dict(config)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(
+            model=ModelConfig.from_dict(config["model"]),
+            train=TrainConfig.from_dict(config["train"]),
+            method=get_method(config["method"]["name"]).from_dict(config["method"]),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Flatten for logging (reference: trlx/data/configs.py:142-149)."""
+        data = self.model.__dict__.copy()
+        data.update(self.train.__dict__)
+        data.update(self.method.__dict__)
+        return data

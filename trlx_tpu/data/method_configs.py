@@ -1,0 +1,103 @@
+"""Per-method hyperparameter dataclasses + registry.
+
+Mirrors the reference's method registry (reference:
+trlx/data/method_configs.py:6-39) with the same method names and fields, plus
+TPU-specific knobs documented inline.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Registry of method configs, keyed by lowercased name
+# (reference: trlx/data/method_configs.py:6).
+_METHODS: Dict[str, type] = {}
+
+
+def register_method(name=None):
+    """Decorator registering a method config class by (lowercased) name
+    (reference: trlx/data/method_configs.py:9-28)."""
+
+    def register_class(cls, registered_name):
+        _METHODS[registered_name.lower()] = cls
+        return cls
+
+    if isinstance(name, str):
+        return lambda cls: register_class(cls, name)
+    if name is None:
+        return lambda cls: register_class(cls, cls.__name__)
+    # bare @register_method usage
+    cls = name
+    return register_class(cls, cls.__name__)
+
+
+def get_method(name: str) -> type:
+    """Return a registered method config class
+    (reference: trlx/data/method_configs.py:31-39)."""
+    name = name.lower()
+    if name in _METHODS:
+        return _METHODS[name]
+    raise Exception(f"Error: Trying to access a method that has not been registered: {name}")
+
+
+@dataclass
+@register_method
+class MethodConfig:
+    """Base method config (reference: trlx/data/method_configs.py:42-55)."""
+
+    name: str = "MethodConfig"
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
+@register_method
+class PPOConfig(MethodConfig):
+    """PPO hyperparameters (reference: trlx/data/method_configs.py:58-110).
+
+    TPU additions: ``gen_kwargs`` lengths are STATIC shapes compiled into the
+    decode loop; ``num_rollouts``/``chunk_size`` should be multiples of the
+    data-axis size so rollout batches shard evenly over the mesh.
+    """
+
+    name: str = "ppoconfig"
+    ppo_epochs: int = 4
+    num_rollouts: int = 128
+    chunk_size: int = 128
+    init_kl_coef: float = 0.2
+    target: Optional[float] = 6.0
+    horizon: int = 10000
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 1.0
+    gen_kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+@register_method
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (reference: trlx/data/method_configs.py:113-145)."""
+
+    name: str = "ilqlconfig"
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.005
+    steps_for_target_q_sync: int = 5
+    betas: List[float] = field(default_factory=lambda: [4.0])
+    two_qs: bool = True
+
+
+@dataclass
+@register_method
+class PPOSoftpromptConfig(PPOConfig):
+    """Soft-prompt PPO: learned prefix embeddings, frozen LM
+    (reference: trlx/data/method_configs.py:148-153)."""
+
+    name: str = "pposoftpromptconfig"
+    n_soft_tokens: int = 8
+    initialize_from_vocab: bool = True

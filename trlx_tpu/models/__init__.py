@@ -1,0 +1,14 @@
+"""Model layer: Flax causal LMs with RL heads.
+
+- :mod:`trlx_tpu.models.lm` — unified TransformerLM (GPT-2 / GPT-J / NeoX
+  families) with functional KV cache and partial-stack application.
+- :mod:`trlx_tpu.models.heads` — value / Q heads and head-carrying wrappers.
+- :mod:`trlx_tpu.models.hf_import` — HF checkpoint → param pytree conversion.
+"""
+
+from trlx_tpu.models.lm import LMConfig, TransformerLM  # noqa: F401
+from trlx_tpu.models.heads import (  # noqa: F401
+    LMWithValueHead,
+    LMWithILQLHeads,
+    extract_branch_params,
+)

@@ -1,0 +1,222 @@
+"""RL heads and head-carrying model wrappers.
+
+TPU-native redesign of the reference's head models
+(reference: trlx/model/nn/ppo_models.py:29-413, trlx/model/nn/ilql_models.py:31-160).
+
+The hydra trick — a frozen ref model sharing the lower trunk with the policy
+(reference: trlx/model/nn/ppo_models.py:315-368) — is functional here: the
+policy and the ref "branch" are the SAME module; the branch is just a second
+`apply` over blocks [k..N) with a frozen pytree subset captured at init
+(`extract_branch_params`). No module deepcopy, no separate nn graph; under
+pjit both applies fuse into one XLA program.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.lm import LMConfig, TransformerLM
+
+
+class MLPHead(nn.Module):
+    """2-layer head: Dense(2*d) → ReLU → Dense(out)
+    (reference: trlx/model/nn/ppo_models.py:29-32 make_head)."""
+
+    out_features: int
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(
+            self.cfg.d_model * 2, dtype=self.cfg.compute_dtype, param_dtype=self.cfg.params_dtype, name="layers_0"
+        )(x)
+        h = nn.relu(h)
+        # Head output in fp32: value/Q targets are small-magnitude scalars and
+        # bf16 rounding hurts GAE/TD numerics.
+        return nn.Dense(
+            self.out_features, dtype=jnp.float32, param_dtype=self.cfg.params_dtype, name="layers_1"
+        )(h)
+
+
+class LMWithValueHead(nn.Module):
+    """Policy LM + scalar value head (+ hydra frozen branch support).
+
+    Equivalent of GPTHydraHeadWithValueModel / GPTHeadWithValueModel
+    (reference: trlx/model/nn/ppo_models.py:35-99,315-413). ``branch_layer`` is
+    the block index where the frozen ref branch starts
+    (= n_layer - num_layers_unfrozen); -1 disables branch collection (fully
+    unfrozen → a separate full ref model is needed, as in the reference's
+    orchestrator fallback, reference: trlx/orchestrator/ppo_orchestrator.py:38-39).
+    """
+
+    cfg: LMConfig
+    branch_layer: int = -1
+
+    def setup(self):
+        self.transformer = TransformerLM(self.cfg)
+        self.v_head = MLPHead(1, self.cfg)
+
+    def __call__(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        position_ids=None,
+        inputs_embeds=None,
+        cache=None,
+        cache_index=None,
+        cache_mask=None,
+        collect_branch_hidden: bool = False,
+    ):
+        out = self.transformer(
+            input_ids=input_ids,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            inputs_embeds=inputs_embeds,
+            cache=cache,
+            cache_index=cache_index,
+            cache_mask=cache_mask,
+            collect_hidden_at=self.branch_layer if (collect_branch_hidden and self.branch_layer >= 0) else None,
+        )
+        values = self.v_head(out["hidden"])[..., 0]
+        return {
+            "logits": out["logits"],
+            "values": values,
+            "hidden": out["hidden"],
+            "branch_hidden": out["branch_hidden"],
+            "cache": out["cache"],
+        }
+
+    def forward_branch(self, branch_hidden, attention_mask=None, position_ids=None):
+        """Replay blocks [branch_layer..N) + ln_f + lm head from the
+        branch-point hidden states. Called via
+        ``model.apply({'params': ref_branch_params}, ..., method='forward_branch')``
+        — the functional `forward_hydra`
+        (reference: trlx/model/nn/ppo_models.py:351-368)."""
+        out = self.transformer(
+            inputs_embeds=branch_hidden,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            start_layer=self.branch_layer,
+        )
+        return out["logits"]
+
+
+class LMWithILQLHeads(nn.Module):
+    """LM + vocab-wide Q head(s) + scalar V head for ILQL
+    (reference: trlx/model/nn/ilql_models.py:31-129).
+
+    Target Q heads are NOT modules here: the trainer holds a frozen pytree
+    copy of the q-head params and evaluates them via ``compute_qs`` with the
+    target subtree swapped in — Polyak sync becomes a pure tree_map blend
+    (vs the reference's GatheredParameters/rank-0 dance,
+    reference: trlx/model/nn/ilql_models.py:131-160).
+    """
+
+    cfg: LMConfig
+    two_qs: bool = True
+
+    def setup(self):
+        self.transformer = TransformerLM(self.cfg)
+        self.v_head = MLPHead(1, self.cfg)
+        self.q1_head = MLPHead(self.cfg.vocab_size, self.cfg)
+        if self.two_qs:
+            self.q2_head = MLPHead(self.cfg.vocab_size, self.cfg)
+
+    def __call__(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        position_ids=None,
+        states_ixs=None,
+        actions_ixs=None,
+        cache=None,
+        cache_index=None,
+        cache_mask=None,
+    ):
+        """Returns dict(logits, qs, vs, hidden, cache).
+
+        With states_ixs/actions_ixs [b, n]: Q heads run only on action hidden
+        states, V head on state hidden states (reference:
+        trlx/model/nn/ilql_models.py:99-118). Without: all positions.
+        """
+        out = self.transformer(
+            input_ids=input_ids,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            cache=cache,
+            cache_index=cache_index,
+            cache_mask=cache_mask,
+        )
+        hs = out["hidden"]
+        if actions_ixs is not None:
+            hs_actions = jnp.take_along_axis(hs, actions_ixs[..., None], axis=1)
+        else:
+            hs_actions = hs
+        if states_ixs is not None:
+            hs_states = jnp.take_along_axis(hs, states_ixs[..., None], axis=1)
+        else:
+            hs_states = hs
+
+        qs = self.compute_qs(hs_actions)
+        vs = self.v_head(hs_states)[..., 0]
+        return {
+            "logits": out["logits"],
+            "qs": qs,
+            "vs": vs,
+            "hidden": hs,
+            "cache": out["cache"],
+        }
+
+    def compute_qs(self, hidden) -> Tuple[jnp.ndarray, ...]:
+        """Q head application; also the target-Q entry point (apply with the
+        target params subtree swapped into 'q1_head'/'q2_head')."""
+        qs = (self.q1_head(hidden),)
+        if self.two_qs:
+            qs = qs + (self.q2_head(hidden),)
+        return qs
+
+
+# ---------------------------------------------------------------------------
+# Param-pytree surgery (the functional hydra / freezing machinery)
+# ---------------------------------------------------------------------------
+
+
+def extract_branch_params(params: dict, cfg: LMConfig, branch_layer: int) -> dict:
+    """Copy the frozen-branch param subset: blocks [branch_layer..N), ln_f,
+    and the LM head (wte when tied). This pytree is the entire "ref model" —
+    the counterpart of ModelBranch's deepcopy of top-k blocks
+    (reference: trlx/model/nn/ppo_models.py:109-129)."""
+    t = params["transformer"]
+    branch = {}
+    for i in range(branch_layer, cfg.n_layer):
+        branch[f"h_{i}"] = t[f"h_{i}"]
+    branch["ln_f"] = t["ln_f"]
+    if cfg.tie_word_embeddings:
+        branch["wte"] = t["wte"]
+    else:
+        branch["lm_head"] = t["lm_head"]
+    return jax.tree_util.tree_map(lambda x: x, {"transformer": branch})  # deep-copy structure
+
+
+def trainable_mask(params: dict, cfg: LMConfig, num_layers_unfrozen: int) -> dict:
+    """Boolean pytree: True where the param trains.
+
+    The functional analogue of requires_grad_(False) layer freezing
+    (reference: trlx/model/accelerate_base_model.py:49-64): with
+    num_layers_unfrozen = k > 0 the bottom N-k blocks are frozen. Embeddings
+    and ln_f stay trainable, exactly like the reference (which freezes only
+    entries of `hidden_layers`). k <= 0 → everything trains.
+    """
+    if num_layers_unfrozen <= 0:
+        return jax.tree_util.tree_map(lambda _: True, params)
+    frozen_blocks = {f"h_{i}" for i in range(cfg.n_layer - num_layers_unfrozen)}
+
+    def mask(path, _leaf):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if "transformer" in keys and any(fb in keys for fb in frozen_blocks):
+            return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(mask, params)

@@ -1,0 +1,366 @@
+"""Unified TPU-native causal transformer LM (Flax).
+
+One module covers the reference's supported families
+(reference: README.md:6 — gpt2 / gpt-j / gpt-neo / gpt-neox):
+
+- GPT-2:  learned positions, sequential residual, fused qkv, tied lm head
+- GPT-J:  rotary (rotary_dim), parallel residual w/ single LN, untied head
+- NeoX:   rotary (rotary_pct), parallel residual w/ two LNs, fused qkv
+
+TPU-first design decisions (vs the reference's HF torch modules,
+reference: trlx/model/nn/ppo_models.py:35-413):
+
+- **Functional KV cache**: an explicit pytree argument `(k, v, mask)` per
+  layer updated with `lax.dynamic_update_slice` — static shapes, donatable,
+  shardable (heads on tp, batch on dp/fsdp). No mutable module state.
+- **Partial-stack application** (`start_layer`/`stop_layer`): the hydra
+  frozen-branch ref model (reference: trlx/model/nn/ppo_models.py:102-312's
+  ModelBranch deepcopy) becomes "apply layers [k..N) + ln_f + head with a
+  frozen param subset" — no module copy, just a second `apply` over a pytree
+  subset (see trlx_tpu.models.heads.extract_branch_params).
+- **bf16 compute / fp32 params**: matmuls hit the MXU in bfloat16; softmax and
+  losses accumulate in fp32.
+- **Static shapes everywhere**: padding + masks, no ragged tensors.
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Dtype = Any
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    """Architecture config (from-scratch capable, HF-checkpoint compatible)."""
+
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 → 4*d_model
+    max_position: int = 1024
+    pos_type: str = "learned"  # "learned" | "rotary"
+    rotary_dim: int = 0  # 0 w/ rotary → full head dim
+    parallel_residual: bool = False  # gptj/neox style
+    use_parallel_ln: bool = False  # neox: separate ln for mlp in parallel block
+    fused_qkv: bool = True
+    qkv_bias: bool = True
+    out_bias: bool = True
+    tie_word_embeddings: bool = True
+    activation: str = "gelu_new"
+    ln_eps: float = 1e-5
+    embd_pdrop: float = 0.0  # dropout unused in RL fine-tuning; kept for parity
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff if self.d_ff else 4 * self.d_model
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def params_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    def replace(self, **kw):
+        return replace(self, **kw)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        return cls(**known)
+
+
+# ---------------------------------------------------------------------------
+# Rotary embeddings (GPT-J/NeoX)
+# ---------------------------------------------------------------------------
+
+
+def rotary_sincos(positions: jnp.ndarray, rotary_dim: int, base: float = 10000.0):
+    """sin/cos tables for rotary positions. positions: [b, t] → [b, t, rd/2]."""
+    inv_freq = 1.0 / (base ** (np.arange(0, rotary_dim, 2) / rotary_dim))
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq[None, None, :]
+    return jnp.sin(freqs), jnp.cos(freqs)
+
+
+def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray, rotary_dim: int, neox_style: bool = False):
+    """Apply rotary embedding to q or k.
+
+    x: [b, t, n_head, head_dim]; sin/cos: [b, t, rotary_dim/2].
+    GPT-J interleaves even/odd pairs; NeoX rotates halves. Both supported —
+    HF-checkpoint numerical fidelity requires matching the layout.
+    """
+    rot = x[..., :rotary_dim].astype(jnp.float32)
+    rest = x[..., rotary_dim:]
+    sin = sin[:, :, None, :]
+    cos = cos[:, :, None, :]
+    if neox_style:
+        half = rotary_dim // 2
+        x1, x2 = rot[..., :half], rot[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    else:
+        x1 = rot[..., ::2]
+        x2 = rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(rot.shape)
+    return jnp.concatenate([out.astype(x.dtype), rest], axis=-1) if rotary_dim < x.shape[-1] else out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Modules
+# ---------------------------------------------------------------------------
+
+
+class Attention(nn.Module):
+    """Multi-head causal attention with functional KV cache.
+
+    Layout: qkv projections are column-parallel over tp (see
+    trlx_tpu/parallel/sharding.py), output projection row-parallel. Softmax in
+    fp32. The cache is `(k, v)` of shape [b, cache_len, n_head, head_dim]
+    written at `cache_index` with dynamic_update_slice.
+    """
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None):
+        cfg = self.cfg
+        dtype = cfg.compute_dtype
+        b, q_len, _ = x.shape
+        hd = cfg.head_dim
+
+        dense = lambda feats, name, use_bias: nn.Dense(
+            feats, dtype=dtype, param_dtype=cfg.params_dtype, use_bias=use_bias, name=name
+        )
+
+        if cfg.fused_qkv:
+            qkv = dense(3 * cfg.d_model, "c_qkv", cfg.qkv_bias)(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = dense(cfg.d_model, "q_proj", cfg.qkv_bias)(x)
+            k = dense(cfg.d_model, "k_proj", cfg.qkv_bias)(x)
+            v = dense(cfg.d_model, "v_proj", cfg.qkv_bias)(x)
+
+        q = q.reshape(b, q_len, cfg.n_head, hd)
+        k = k.reshape(b, q_len, cfg.n_head, hd)
+        v = v.reshape(b, q_len, cfg.n_head, hd)
+
+        if cfg.pos_type == "rotary":
+            rd = cfg.rotary_dim or hd
+            sin, cos = rotary_sincos(positions, rd)
+            neox = cfg.extra.get("neox_rotary", False)
+            q = apply_rotary(q, sin, cos, rd, neox)
+            k = apply_rotary(k, sin, cos, rd, neox)
+
+        new_cache = None
+        if cache is not None:
+            k_cache, v_cache = cache
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
+            k, v = k_cache, v_cache
+            new_cache = (k_cache, v_cache)
+
+        # [b, n_head, q, kv] scores in fp32 for a stable softmax.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        scores = scores / np.sqrt(hd)
+        scores = scores + attn_bias  # additive -inf mask [b, 1, q, kv]
+        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(dtype))
+        out = out.reshape(b, q_len, cfg.d_model)
+        out = dense(cfg.d_model, "c_proj", cfg.out_bias)(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.ff_dim, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="c_fc")(x)
+        if cfg.activation == "gelu_new":
+            h = nn.gelu(h, approximate=True)
+        elif cfg.activation == "gelu":
+            h = nn.gelu(h, approximate=False)
+        elif cfg.activation == "relu":
+            h = nn.relu(h)
+        else:
+            raise ValueError(f"unknown activation {cfg.activation}")
+        return nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="c_proj")(h)
+
+
+class Block(nn.Module):
+    """One transformer block; sequential (gpt2) or parallel (gptj/neox) residual."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name=name)
+        if cfg.parallel_residual:
+            h = ln("ln_1")(x)
+            attn_out, new_cache = Attention(cfg, name="attn")(h, attn_bias, positions, cache, cache_index)
+            mlp_in = ln("ln_2")(x) if cfg.use_parallel_ln else h
+            x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
+        else:
+            attn_out, new_cache = Attention(cfg, name="attn")(ln("ln_1")(x), attn_bias, positions, cache, cache_index)
+            x = x + attn_out
+            x = x + MLP(cfg, name="mlp")(ln("ln_2")(x))
+        return x, new_cache
+
+
+def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset) -> jnp.ndarray:
+    """Build the additive attention bias [b, 1, q_len, kv_len].
+
+    attn_mask_kv: [b, kv_len] validity of each key slot (handles left padding
+    — the reference instead relies on HF mask plumbing plus position-id
+    correction, reference: trlx/model/accelerate_ppo_model.py:110-112).
+    Causality is by buffer index: key j visible to query i iff j <= q_offset+i.
+    """
+    kv_len = attn_mask_kv.shape[-1]
+    q_idx = q_offset + jnp.arange(q_len)[:, None]
+    k_idx = jnp.arange(kv_len)[None, :]
+    causal = (k_idx <= q_idx)[None, None, :, :]
+    valid = attn_mask_kv[:, None, None, :].astype(bool) & causal
+    return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
+
+
+class TransformerLM(nn.Module):
+    """The trunk: embeddings + N blocks + final LN (+ optional untied head).
+
+    `__call__` supports partial-stack application for the hydra ref branch:
+    with `start_layer=k` and `inputs_embeds` = branch-point hidden states, it
+    replays only blocks [k..N) + ln_f + head — the functional equivalent of the
+    reference's ModelBranch (reference: trlx/model/nn/ppo_models.py:102-312).
+    """
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: Optional[jnp.ndarray] = None,
+        attention_mask: Optional[jnp.ndarray] = None,
+        position_ids: Optional[jnp.ndarray] = None,
+        inputs_embeds: Optional[jnp.ndarray] = None,
+        cache: Optional[Tuple] = None,
+        cache_index=None,
+        cache_mask: Optional[jnp.ndarray] = None,
+        start_layer: int = 0,
+        stop_layer: Optional[int] = None,
+        collect_hidden_at: Optional[int] = None,
+        compute_logits: bool = True,
+    ):
+        """Returns dict(logits, hidden, branch_hidden, cache).
+
+        - Training/prefill: cache=None, attention over the q_len itself.
+        - Decode: cache=(per-layer (k,v)), cache_mask [b, kv_len] marks valid
+          key slots, cache_index = write offset (static-shape dynamic slice).
+        - `collect_hidden_at=k` also returns the hidden state entering block k
+          (the hydra branch point, reference:
+          trlx/model/nn/ppo_models.py:351-368's `forward_hydra` hidden pick).
+        """
+        cfg = self.cfg
+        stop_layer = cfg.n_layer if stop_layer is None else stop_layer
+
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="wte"
+        )
+        if inputs_embeds is None:
+            x = wte(input_ids)
+        else:
+            x = inputs_embeds.astype(cfg.compute_dtype)
+
+        b, q_len = x.shape[:2]
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, q_len), dtype=jnp.int32)
+        if position_ids is None:
+            if cache is not None and cache_mask is not None:
+                # Decode mode: derive absolute positions from the cache
+                # occupancy mask (which already includes the query slots),
+                # sliced at the write offset — NOT from the 1-token query mask.
+                full_pos = jnp.maximum(jnp.cumsum(cache_mask, axis=-1) - 1, 0)
+                position_ids = jax.lax.dynamic_slice_in_dim(full_pos, cache_index, q_len, axis=1)
+            else:
+                # Left-pad aware positions: cumsum over valid tokens
+                # (reference: trlx/model/accelerate_ppo_model.py:110-112).
+                position_ids = jnp.maximum(jnp.cumsum(attention_mask, axis=-1) - 1, 0)
+
+        if start_layer == 0 and cfg.pos_type == "learned":
+            wpe = nn.Embed(
+                cfg.max_position, cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="wpe"
+            )(position_ids)
+            x = x + wpe
+
+        if cache is not None:
+            kv_mask = cache_mask if cache_mask is not None else attention_mask
+            attn_bias = make_attn_bias(kv_mask, q_len, cache_index)
+        else:
+            attn_bias = make_attn_bias(attention_mask, q_len, 0)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+
+        branch_hidden = None
+        new_cache = [] if cache is not None else None
+        for i in range(cfg.n_layer):
+            # All blocks are *defined* every call so the param structure is
+            # identical regardless of start/stop — only [start, stop) execute.
+            block = block_cls(cfg, name=f"h_{i}")
+            if i < start_layer or i >= stop_layer:
+                continue
+            if collect_hidden_at is not None and i == collect_hidden_at:
+                branch_hidden = x
+            layer_cache = cache[i] if cache is not None else None
+            x, layer_new_cache = block(x, attn_bias, position_ids, layer_cache, cache_index)
+            if cache is not None:
+                new_cache.append(layer_new_cache)
+
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="ln_f")(x)
+        if collect_hidden_at is not None and collect_hidden_at == cfg.n_layer:
+            branch_hidden = x
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = wte.attend(x)
+            else:
+                logits = nn.Dense(
+                    cfg.vocab_size,
+                    dtype=cfg.compute_dtype,
+                    param_dtype=cfg.params_dtype,
+                    use_bias=cfg.extra.get("lm_head_bias", False),
+                    name="lm_head",
+                )(x)
+
+        return {
+            "logits": logits,
+            "hidden": x,
+            "branch_hidden": branch_hidden,
+            "cache": tuple(new_cache) if new_cache is not None else None,
+        }
+
+
+def init_cache(cfg: LMConfig, batch: int, max_len: int, dtype=None):
+    """Allocate an empty KV cache pytree: per-layer (k, v) [b, T, n_head, hd]."""
+    dtype = dtype or cfg.compute_dtype
+    shape = (batch, max_len, cfg.n_head, cfg.head_dim)
+    zero = lambda: jnp.zeros(shape, dtype=dtype)
+    return tuple((zero(), zero()) for _ in range(cfg.n_layer))

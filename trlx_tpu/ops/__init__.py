@@ -1,0 +1,11 @@
+"""Device-side ops: math primitives, sampling, generation, losses, kernels."""
+
+from trlx_tpu.ops.modeling import (  # noqa: F401
+    clip_by_value,
+    logprobs_from_logits,
+    masked_mean,
+    masked_var,
+    masked_whiten,
+    topk_mask,
+    whiten,
+)

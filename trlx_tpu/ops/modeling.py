@@ -1,0 +1,93 @@
+"""Math primitives used by the RL losses.
+
+JAX re-design of the reference's torch helpers
+(reference: trlx/utils/modeling.py:5-29, trlx/utils/__init__.py:94-103).
+All functions are pure, jit-safe, and mask-aware (the reference operates on
+ragged unpadded tensors; on TPU everything is padded + masked, so the masked
+variants are the load-bearing ones).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import nn as jnn
+
+
+def whiten(values: jnp.ndarray, shift_mean: bool = True) -> jnp.ndarray:
+    """Normalize to zero mean / unit variance
+    (reference: trlx/utils/modeling.py:5-11)."""
+    mean = jnp.mean(values)
+    var = jnp.var(values)
+    whitened = (values - mean) * jnp.reciprocal(jnp.sqrt(var + 1e-8))
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean over positions where mask == 1."""
+    mask = mask.astype(values.dtype)
+    return jnp.sum(values * mask, axis=axis) / jnp.maximum(jnp.sum(mask, axis=axis), 1e-8)
+
+
+def masked_var(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Variance over positions where mask == 1."""
+    mean = masked_mean(values, mask)
+    return masked_mean(jnp.square(values - mean), mask)
+
+
+def masked_whiten(values: jnp.ndarray, mask: jnp.ndarray, shift_mean: bool = True) -> jnp.ndarray:
+    """Whiten only over valid (mask==1) positions — the padded-shape analogue
+    of the reference's ``whiten`` over ragged advantages
+    (reference: trlx/model/accelerate_ppo_model.py:100)."""
+    mean = masked_mean(values, mask)
+    var = masked_var(values, mask)
+    whitened = (values - mean) * jnp.reciprocal(jnp.sqrt(var + 1e-8))
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened * mask.astype(values.dtype)
+
+
+def clip_by_value(x: jnp.ndarray, tensor_min, tensor_max) -> jnp.ndarray:
+    """Clamp (reference: trlx/utils/modeling.py:14-20)."""
+    return jnp.clip(x, tensor_min, tensor_max)
+
+
+def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-token log-probabilities of ``labels`` under ``logits``
+    (reference: trlx/utils/modeling.py:23-29).
+
+    logits: [..., vocab]; labels: [...] int. Softmax runs in float32 for
+    numerical stability regardless of the compute dtype (bf16 matmuls feed
+    fp32 log-softmax — standard TPU practice).
+    """
+    logp = jnn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Set all but the top-k values along the last axis to -inf
+    (reference: trlx/utils/__init__.py:94-103, trlx/model/nn/ilql_models.py:18-22).
+
+    ``k`` must be static under jit (it shapes the top_k lowering).
+    """
+    kth = jnp.sort(xs, axis=-1)[..., -k][..., None]
+    return jnp.where(xs < kth, jnp.full_like(xs, -jnp.inf), xs)
+
+
+def gather_hidden_at(hidden: jnp.ndarray, ixs: jnp.ndarray) -> jnp.ndarray:
+    """Gather hidden states at per-sample time indices.
+
+    hidden: [batch, seq, d]; ixs: [batch, n] int → [batch, n, d].
+    (Replaces the reference's ``.gather`` over states/actions indices,
+    reference: trlx/model/nn/ilql_models.py:99-118.)
+    """
+    return jnp.take_along_axis(hidden, ixs[..., None], axis=1)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
+    """Mean token cross-entropy with optional mask (fp32 accumulation)."""
+    nll = -logprobs_from_logits(logits, labels)
+    if mask is None:
+        return jnp.mean(nll)
+    return masked_mean(nll, mask)

@@ -1,0 +1,30 @@
+"""Distributed runtime: device mesh, sharding specs, collectives.
+
+This package is the first-class replacement for the runtime layer the
+reference delegates entirely to Accelerate/DeepSpeed/torch.distributed
+(reference: trlx/model/accelerate_base_model.py:31-36,
+configs/deepspeed_configs/default_configs.yml). Here it is explicit and ours:
+
+- :mod:`trlx_tpu.parallel.mesh` — mesh construction over dp/fsdp/tp/sp axes,
+  multi-host bootstrap (`jax.distributed.initialize`), barriers.
+- :mod:`trlx_tpu.parallel.sharding` — partition rules for params, optimizer
+  states (ZeRO ≡ fsdp axis sharding), activations, and rollout batches.
+"""
+
+from trlx_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    DATA_AXES,
+    barrier,
+    get_mesh,
+    make_mesh,
+    set_mesh,
+)
+from trlx_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    lm_partition_rules,
+    match_partition_rules,
+    shard_pytree,
+)
