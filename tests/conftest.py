@@ -1,0 +1,16 @@
+"""Test env: force CPU JAX with 8 virtual devices BEFORE any jax backend init.
+
+The reference has no distributed tests at all (SURVEY.md §4); here mesh
+semantics are tested single-host via --xla_force_host_platform_device_count.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,52 @@
+"""Config system + registries (reference has no such tests; ours cover the
+YAML → dataclass path the whole framework hangs off)."""
+
+import os
+
+import pytest
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import get_method, PPOConfig, ILQLConfig
+
+CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "trlx_tpu", "configs")
+
+
+def test_load_default_ppo_yaml():
+    cfg = TRLConfig.load_yaml(os.path.join(CONFIG_DIR, "ppo_config.yml"))
+    assert cfg.method.name == "ppoconfig"
+    assert cfg.method.ppo_epochs == 4
+    assert cfg.train.mesh == (-1, 1, 1, 1)
+    assert cfg.model.num_layers_unfrozen == 2
+    d = cfg.to_dict()
+    assert "cliprange" in d and "seq_length" in d
+
+
+def test_load_default_ilql_yaml():
+    cfg = TRLConfig.load_yaml(os.path.join(CONFIG_DIR, "ilql_config.yml"))
+    assert cfg.method.name == "ilqlconfig"
+    assert cfg.method.two_qs is True
+    assert cfg.method.betas == [16]
+
+
+def test_method_registry():
+    assert get_method("ppoconfig") is PPOConfig
+    assert get_method("ILQLConfig") is ILQLConfig
+    with pytest.raises(Exception):
+        get_method("nonexistent")
+
+
+def test_trainer_registry_names():
+    import trlx_tpu.trainer.api  # populates registries
+    from trlx_tpu.trainer import get_model
+
+    # reference-compatible names resolve (reference: configs/*.yml model_type)
+    assert get_model("AcceleratePPOModel") is get_model("ppo")
+    assert get_model("ILQLModel") is get_model("ilql")
+
+
+def test_orchestrator_registry():
+    import trlx_tpu.trainer.api  # noqa: F401
+    from trlx_tpu.orchestrator import get_orchestrator
+
+    assert get_orchestrator("PPOOrchestrator") is not None
+    assert get_orchestrator("OfflineOrchestrator") is not None

@@ -91,6 +91,8 @@ class PPORLElement:
     logprobs: Any
     values: Any
     rewards: Any
+    response_mask: Any = None
+    query_mask: Any = None
 
 
 @_register_pytree
@@ -102,9 +104,10 @@ class PPORLBatch:
     query_tensors:    [batch, query_len]   (left-padded)
     response_tensors: [batch, response_len] (right-padded)
     logprobs/values/rewards: [batch, response_len]
-    response_mask:    [batch, response_len] — 1 where a real response token.
-       TPU addition: explicit mask instead of runtime pad-id comparisons, so
-       loss masking is shape-static and fusable.
+    response_mask/query_mask: explicit validity masks — TPU addition; the
+       reference infers masks as tokens != pad_id
+       (trlx/model/accelerate_ppo_model.py:104-108), which mis-masks BOS when
+       bos == eos == pad (gpt2). Explicit masks are also shape-static.
     """
 
     query_tensors: Any
@@ -113,6 +116,7 @@ class PPORLBatch:
     values: Any
     rewards: Any
     response_mask: Any = None
+    query_mask: Any = None
 
 
 @_register_pytree

@@ -90,6 +90,9 @@ class ILQLConfig(MethodConfig):
     steps_for_target_q_sync: int = 5
     betas: List[float] = field(default_factory=lambda: [4.0])
     two_qs: bool = True
+    # TPU addition: decode shapes/params must be static; the reference builds
+    # them ad hoc in prepare_learning (trlx/model/accelerate_ilql_model.py:158-181).
+    gen_kwargs: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -197,7 +197,10 @@ def extract_branch_params(params: dict, cfg: LMConfig, branch_layer: int) -> dic
         branch["wte"] = t["wte"]
     else:
         branch["lm_head"] = t["lm_head"]
-    return jax.tree_util.tree_map(lambda x: x, {"transformer": branch})  # deep-copy structure
+    # Real copies, not aliases: the frozen branch must not share buffers with
+    # the trainable params (donation would see the same buffer twice, and the
+    # "frozen" semantics require an immutable snapshot).
+    return jax.tree_util.tree_map(jnp.copy, {"transformer": branch})
 
 
 def trainable_mask(params: dict, cfg: LMConfig, num_layers_unfrozen: int) -> dict:

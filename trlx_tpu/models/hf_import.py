@@ -1,0 +1,239 @@
+"""HF checkpoint → trlx_tpu param pytree conversion.
+
+The reference builds models with AutoModelForCausalLM.from_pretrained
+(reference: trlx/model/nn/ppo_models.py:322-325). Here HF is only a WEIGHT
+SOURCE: torch state dicts are converted once, on host, into our Flax layout;
+the TPU program never touches torch. Supported families match the reference's
+(reference: README.md:6): gpt2, gpt-j, gpt-neox. With no checkpoint (or
+`model_arch` given) params initialize from scratch — the randomwalks path
+(reference: examples/randomwalks.py:99-101).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.lm import LMConfig
+
+
+def build_lm_config(config) -> LMConfig:
+    """Resolve an LMConfig from model_arch overrides or an HF config."""
+    mc = config.model
+    base: Dict[str, Any] = dict(
+        dtype=mc.dtype, param_dtype=mc.param_dtype, remat=mc.remat
+    )
+    if mc.model_arch:
+        return LMConfig.from_dict({**base, **mc.model_arch})
+    if not mc.model_path:
+        raise ValueError("Either model.model_path or model.model_arch must be set")
+    from transformers import AutoConfig
+
+    hf = AutoConfig.from_pretrained(mc.model_path)
+    return lm_config_from_hf(hf, **base)
+
+
+def lm_config_from_hf(hf, **overrides) -> LMConfig:
+    t = hf.model_type
+    if t == "gpt2":
+        d = dict(
+            vocab_size=hf.vocab_size,
+            n_layer=hf.n_layer,
+            n_head=hf.n_head,
+            d_model=hf.n_embd,
+            max_position=hf.n_positions,
+            pos_type="learned",
+            parallel_residual=False,
+            fused_qkv=True,
+            qkv_bias=True,
+            tie_word_embeddings=True,
+            activation="gelu_new",
+            ln_eps=hf.layer_norm_epsilon,
+        )
+    elif t == "gptj":
+        d = dict(
+            vocab_size=hf.vocab_size,
+            n_layer=hf.n_layer,
+            n_head=hf.n_head,
+            d_model=hf.n_embd,
+            max_position=hf.n_positions,
+            pos_type="rotary",
+            rotary_dim=hf.rotary_dim or (hf.n_embd // hf.n_head),
+            parallel_residual=True,
+            use_parallel_ln=False,
+            fused_qkv=False,
+            qkv_bias=False,
+            out_bias=False,
+            tie_word_embeddings=False,
+            activation="gelu_new",
+            ln_eps=hf.layer_norm_epsilon,
+            extra={"lm_head_bias": True},
+        )
+    elif t == "gpt_neox":
+        head_dim = hf.hidden_size // hf.num_attention_heads
+        d = dict(
+            vocab_size=hf.vocab_size,
+            n_layer=hf.num_hidden_layers,
+            n_head=hf.num_attention_heads,
+            d_model=hf.hidden_size,
+            d_ff=hf.intermediate_size,
+            max_position=hf.max_position_embeddings,
+            pos_type="rotary",
+            rotary_dim=int(hf.rotary_pct * head_dim),
+            parallel_residual=getattr(hf, "use_parallel_residual", True),
+            use_parallel_ln=True,
+            fused_qkv=True,
+            qkv_bias=True,
+            tie_word_embeddings=False,
+            activation="gelu",
+            ln_eps=hf.layer_norm_eps,
+            extra={"neox_rotary": True},
+        )
+    else:
+        raise ValueError(f"unsupported HF model_type for conversion: {t}")
+    d.update(overrides)
+    return LMConfig.from_dict(d)
+
+
+def load_or_init_params(model, config, rng) -> Dict[str, Any]:
+    """Initialize params; when a checkpoint is available, splice converted HF
+    trunk weights over the fresh init (heads stay fresh, like the reference's
+    newly-initialized value/Q heads, reference: trlx/model/nn/ppo_models.py:333)."""
+    cfg = model.cfg
+    dummy = jnp.zeros((1, 2), dtype=jnp.int32)
+    params = model.init(rng, dummy, jnp.ones_like(dummy))["params"]
+    mc = config.model
+    if mc.model_path and not mc.model_arch:
+        trunk = load_hf_trunk(mc.model_path, cfg)
+        params = {**params, "transformer": trunk}
+    return params
+
+
+def load_hf_trunk(model_path: str, cfg: LMConfig) -> Dict[str, Any]:
+    """Load an HF torch checkpoint and convert the transformer trunk."""
+    import torch  # host-only
+    from transformers import AutoModelForCausalLM
+
+    hf_model = AutoModelForCausalLM.from_pretrained(model_path, torch_dtype=torch.float32)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    del hf_model
+    t = _detect_family(sd)
+    if t == "gpt2":
+        return convert_gpt2(sd, cfg)
+    if t == "gptj":
+        return convert_gptj(sd, cfg)
+    if t == "gpt_neox":
+        return convert_neox(sd, cfg)
+    raise ValueError(f"cannot detect supported family from state dict ({list(sd)[:3]}...)")
+
+
+def _detect_family(sd) -> str:
+    if any(k.startswith("transformer.h.") and ".attn.c_attn." in k for k in sd):
+        return "gpt2"
+    if any(".attn.q_proj." in k for k in sd):
+        return "gptj"
+    if any("gpt_neox.layers." in k for k in sd):
+        return "gpt_neox"
+    return "unknown"
+
+
+def _ln(sd, prefix):
+    return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+
+
+def convert_gpt2(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    """GPT-2: HF Conv1D weights are already [in, out] — direct copy."""
+    p: Dict[str, Any] = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "wpe": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+    }
+    for i in range(cfg.n_layer):
+        h = f"transformer.h.{i}"
+        p[f"h_{i}"] = {
+            "ln_1": _ln(sd, f"{h}.ln_1"),
+            "ln_2": _ln(sd, f"{h}.ln_2"),
+            "attn": {
+                "c_qkv": {"kernel": sd[f"{h}.attn.c_attn.weight"], "bias": sd[f"{h}.attn.c_attn.bias"]},
+                "c_proj": {"kernel": sd[f"{h}.attn.c_proj.weight"], "bias": sd[f"{h}.attn.c_proj.bias"]},
+            },
+            "mlp": {
+                "c_fc": {"kernel": sd[f"{h}.mlp.c_fc.weight"], "bias": sd[f"{h}.mlp.c_fc.bias"]},
+                "c_proj": {"kernel": sd[f"{h}.mlp.c_proj.weight"], "bias": sd[f"{h}.mlp.c_proj.bias"]},
+            },
+        }
+    return p
+
+
+def convert_gptj(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    """GPT-J: nn.Linear weights are [out, in] — transpose to Flax [in, out]."""
+    p: Dict[str, Any] = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+        "lm_head": {"kernel": sd["lm_head.weight"].T, "bias": sd["lm_head.bias"]},
+    }
+    for i in range(cfg.n_layer):
+        h = f"transformer.h.{i}"
+        p[f"h_{i}"] = {
+            "ln_1": _ln(sd, f"{h}.ln_1"),
+            "attn": {
+                "q_proj": {"kernel": sd[f"{h}.attn.q_proj.weight"].T},
+                "k_proj": {"kernel": sd[f"{h}.attn.k_proj.weight"].T},
+                "v_proj": {"kernel": sd[f"{h}.attn.v_proj.weight"].T},
+                "c_proj": {"kernel": sd[f"{h}.attn.out_proj.weight"].T},
+            },
+            "mlp": {
+                "c_fc": {"kernel": sd[f"{h}.mlp.fc_in.weight"].T, "bias": sd[f"{h}.mlp.fc_in.bias"]},
+                "c_proj": {"kernel": sd[f"{h}.mlp.fc_out.weight"].T, "bias": sd[f"{h}.mlp.fc_out.bias"]},
+            },
+        }
+    return p
+
+
+def convert_neox(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    """GPT-NeoX: fused query_key_value is laid out [n_head, 3, head_dim] on
+    the output dim — permute into our q|k|v block layout."""
+    nh, hd, d = cfg.n_head, cfg.head_dim, cfg.d_model
+
+    def qkv_w(w):  # [3d, d] torch → [d, 3d] ours (q|k|v)
+        w = w.reshape(nh, 3, hd, d)  # heads-major interleave
+        w = np.concatenate([w[:, j] for j in range(3)], axis=0)  # [3*nh, hd, d]
+        return w.reshape(3 * d, d).T
+
+    def qkv_b(b):
+        b = b.reshape(nh, 3, hd)
+        return np.concatenate([b[:, j] for j in range(3)], axis=0).reshape(3 * d)
+
+    p: Dict[str, Any] = {
+        "wte": {"embedding": sd["gpt_neox.embed_in.weight"]},
+        "ln_f": _ln(sd, "gpt_neox.final_layer_norm"),
+        "lm_head": {"kernel": sd["embed_out.weight"].T},
+    }
+    for i in range(cfg.n_layer):
+        h = f"gpt_neox.layers.{i}"
+        p[f"h_{i}"] = {
+            "ln_1": _ln(sd, f"{h}.input_layernorm"),
+            "ln_2": _ln(sd, f"{h}.post_attention_layernorm"),
+            "attn": {
+                "c_qkv": {
+                    "kernel": qkv_w(sd[f"{h}.attention.query_key_value.weight"]),
+                    "bias": qkv_b(sd[f"{h}.attention.query_key_value.bias"]),
+                },
+                "c_proj": {
+                    "kernel": sd[f"{h}.attention.dense.weight"].T,
+                    "bias": sd[f"{h}.attention.dense.bias"],
+                },
+            },
+            "mlp": {
+                "c_fc": {
+                    "kernel": sd[f"{h}.mlp.dense_h_to_4h.weight"].T,
+                    "bias": sd[f"{h}.mlp.dense_h_to_4h.bias"],
+                },
+                "c_proj": {
+                    "kernel": sd[f"{h}.mlp.dense_4h_to_h.weight"].T,
+                    "bias": sd[f"{h}.mlp.dense_4h_to_h.bias"],
+                },
+            },
+        }
+    return p

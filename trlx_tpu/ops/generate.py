@@ -1,0 +1,148 @@
+"""Jitted autoregressive decode: prefill + `lax.while_loop` token loop.
+
+Replaces both HF `.generate` under no_grad
+(reference: trlx/model/accelerate_base_model.py:105-116) and ILQL's Python
+per-token loop (reference: trlx/model/nn/ilql_models.py:162-251) with ONE
+compiled XLA program per (batch, prompt_len, max_new_tokens) shape:
+
+- prompts are LEFT-padded to a static length (the reference's left-padding
+  discipline, reference: trlx/model/accelerate_base_model.py:42-45), so the
+  last prompt position is always the sampling position;
+- the KV cache is a donated, sharded pytree (heads on tp, batch on dp/fsdp);
+- the while_loop exits early when every sequence has finished — on TPU this
+  is the difference between paying for max_new_tokens and paying for the
+  actual longest sample;
+- logit processing (HF chain or ILQL advantage steering) is a pure function
+  fused into the step.
+"""
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.lm import init_cache
+from trlx_tpu.ops.sampling import GenerateConfig, process_logits_default
+
+
+def generate(
+    variables,
+    prompt_ids: jnp.ndarray,
+    prompt_mask: jnp.ndarray,
+    rng: jax.Array,
+    *,
+    model,
+    gcfg: GenerateConfig,
+    processor: Optional[Callable] = None,
+    carry_keys: Tuple[str, ...] = (),
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Decode `gcfg.max_new_tokens` tokens after left-padded prompts.
+
+    prompt_ids/prompt_mask: [b, P] (left-padded). Returns (tokens, mask) of
+    shape [b, P + max_new_tokens]; generated positions after a sequence
+    finishes hold pad_token_id with mask 0.
+
+    `carry_keys` names model-output entries (e.g. "qs", "vs" for ILQL) whose
+    last-position values are carried through the loop and handed to the
+    processor under state["carry"] — this is how advantage-steered decoding
+    reads the Q/V heads each step.
+    """
+    cfg = model.cfg
+    B, P = prompt_ids.shape
+    N = gcfg.max_new_tokens
+    T = P + N
+    eos = gcfg.eos_token_id
+
+    tokens = jnp.concatenate(
+        [prompt_ids, jnp.full((B, N), gcfg.pad_token_id, dtype=prompt_ids.dtype)], axis=1
+    )
+    mask = jnp.concatenate([prompt_mask.astype(jnp.int32), jnp.zeros((B, N), dtype=jnp.int32)], axis=1)
+
+    cache = init_cache(cfg, B, T)
+    out = model.apply(
+        variables,
+        input_ids=prompt_ids,
+        attention_mask=prompt_mask,
+        cache=cache,
+        cache_index=0,
+        cache_mask=mask,
+    )
+
+    def last_pos(tree):
+        return jax.tree_util.tree_map(lambda x: x[:, -1], tree)
+
+    state = {
+        "tokens": tokens,
+        "mask": mask,
+        "cache": out["cache"],
+        "finished": jnp.zeros((B,), dtype=bool),
+        "rng": rng,
+        "step": jnp.array(0, dtype=jnp.int32),
+        "last_logits": out["logits"][:, -1].astype(jnp.float32),
+        "last_hidden": out["hidden"][:, -1],
+        "carry": {k: last_pos(out[k]) for k in carry_keys},
+    }
+
+    def cond(s):
+        return (s["step"] < N) & ~jnp.all(s["finished"])
+
+    def body(s):
+        step = s["step"]
+        last_token = jax.lax.dynamic_slice_in_dim(s["tokens"], P - 1 + step, 1, axis=1)[:, 0]
+        if processor is not None:
+            logits = processor(
+                s["last_logits"],
+                {"last_token": last_token, "hidden": s["last_hidden"], "step": step, "carry": s["carry"]},
+            )
+        else:
+            logits = process_logits_default(s["last_logits"], gcfg, step)
+
+        rng, sub = jax.random.split(s["rng"])
+        if gcfg.do_sample:
+            tok = jax.random.categorical(sub, logits, axis=-1)
+        else:
+            tok = jnp.argmax(logits, axis=-1)
+        tok = tok.astype(s["tokens"].dtype)
+
+        was_finished = s["finished"]
+        tok = jnp.where(was_finished, gcfg.pad_token_id, tok)
+        finished = was_finished | (tok == eos) if eos is not None else was_finished
+
+        write_pos = P + step
+        tokens = jax.lax.dynamic_update_slice(s["tokens"], tok[:, None], (0, write_pos))
+        mask_bit = (~was_finished).astype(jnp.int32)
+        mask = jax.lax.dynamic_update_slice(s["mask"], mask_bit[:, None], (0, write_pos))
+
+        step_out = model.apply(
+            variables,
+            input_ids=tok[:, None],
+            attention_mask=jnp.ones((B, 1), dtype=jnp.int32),
+            cache=s["cache"],
+            cache_index=write_pos,
+            cache_mask=mask,
+        )
+        return {
+            "tokens": tokens,
+            "mask": mask,
+            "cache": step_out["cache"],
+            "finished": finished,
+            "rng": rng,
+            "step": step + 1,
+            "last_logits": step_out["logits"][:, 0].astype(jnp.float32),
+            "last_hidden": step_out["hidden"][:, 0],
+            "carry": {k: last_pos(step_out[k]) for k in carry_keys},
+        }
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final["tokens"], final["mask"]
+
+
+def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = ()):
+    """Build a jitted generate fn of (variables, prompt_ids, prompt_mask, rng).
+
+    Call once per (model, gcfg, processor) and reuse — each distinct
+    (batch, prompt_len) shape compiles once, then is cached.
+    """
+    fn = partial(generate, model=model, gcfg=gcfg, processor=processor, carry_keys=carry_keys)
+    return jax.jit(fn)

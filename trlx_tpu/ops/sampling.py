@@ -1,0 +1,143 @@
+"""Logit processors for the decode loop.
+
+HF-generate-equivalent semantics (temperature → top-k → top-p, min-length EOS
+suppression), re-expressed as pure jit-safe functions over fixed-shape logits
+(replacing HF `.generate`'s processor stack used at
+reference: trlx/model/accelerate_base_model.py:105-116), plus the ILQL
+advantage-steered chain (reference: trlx/model/nn/ilql_models.py:203-221).
+"""
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.modeling import topk_mask
+
+NEG_INF = -1e9
+
+
+@dataclass(frozen=True)
+class GenerateConfig:
+    """Static decode parameters (compiled into the loop).
+
+    Mirrors the reference's gen_kwargs (configs/ppo_config.yml:33-38:
+    max_length/min_length/top_k/top_p/do_sample/temperature) with explicit
+    token counts instead of total lengths.
+    """
+
+    max_new_tokens: int = 32
+    min_new_tokens: int = 0
+    do_sample: bool = True
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+    @classmethod
+    def from_gen_kwargs(cls, gen_kwargs: dict, prompt_len: int = 0, pad_token_id: int = 0, eos_token_id=None):
+        """Translate reference-style gen_kwargs (max_length = prompt+gen)."""
+        kw = dict(gen_kwargs)
+        if "max_new_tokens" in kw:
+            max_new = kw["max_new_tokens"]
+        elif "max_length" in kw:
+            max_new = max(kw["max_length"] - prompt_len, 1)
+        else:
+            max_new = 32
+        if "min_new_tokens" in kw:
+            min_new = kw["min_new_tokens"]
+        elif "min_length" in kw:
+            min_new = max(kw["min_length"] - prompt_len, 0)
+        else:
+            min_new = 0
+        return cls(
+            max_new_tokens=int(max_new),
+            min_new_tokens=int(min_new),
+            do_sample=bool(kw.get("do_sample", True)),
+            temperature=float(kw.get("temperature", 1.0)),
+            top_k=int(kw.get("top_k", 0)),
+            top_p=float(kw.get("top_p", 1.0)),
+            eos_token_id=kw.get("eos_token_id", eos_token_id),
+            pad_token_id=int(kw.get("pad_token_id", pad_token_id)),
+        )
+
+
+def process_logits_default(logits: jnp.ndarray, gcfg: GenerateConfig, step: jnp.ndarray) -> jnp.ndarray:
+    """The HF-equivalent chain: min-length EOS suppression → temperature →
+    top-k → top-p. logits: [b, vocab] fp32."""
+    logits = logits.astype(jnp.float32)
+    if gcfg.eos_token_id is not None and gcfg.min_new_tokens > 0:
+        suppress = step < gcfg.min_new_tokens
+        eos_col = jnp.zeros_like(logits).at[:, gcfg.eos_token_id].set(NEG_INF)
+        logits = jnp.where(suppress, logits + eos_col, logits)
+    if gcfg.temperature != 1.0:
+        logits = logits / gcfg.temperature
+    if gcfg.top_k > 0:
+        logits = jnp.maximum(topk_mask(logits, gcfg.top_k), NEG_INF)
+    if gcfg.top_p < 1.0:
+        logits = top_p_mask(logits, gcfg.top_p)
+    return logits
+
+
+def top_p_mask(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= top_p (HF semantics: the first token whose
+    cumulative prob exceeds top_p is kept, the rest dropped)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens where the cumulative prob *before* them is < top_p
+    keep_sorted = (cum - probs) < top_p
+    # threshold = smallest kept logit
+    threshold = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+def make_bigram_mask_processor(logit_mask: jnp.ndarray) -> Callable:
+    """Bigram transition masking: forbid token j after token i where
+    logit_mask[i, j] is True (reference: trlx/model/nn/ilql_models.py:211-212;
+    used by examples/randomwalks.py:83 as ¬adjacency)."""
+    logit_mask = jnp.asarray(logit_mask)
+
+    def processor(logits: jnp.ndarray, state: dict) -> jnp.ndarray:
+        forbidden = logit_mask[state["last_token"]]  # [b, vocab] bool
+        return jnp.where(forbidden, NEG_INF, logits)
+
+    return processor
+
+
+def make_ilql_processor(
+    compute_target_qs: Callable,
+    beta: float,
+    top_k: int = 20,
+    temperature: float = 1.0,
+    logit_mask: Optional[jnp.ndarray] = None,
+) -> Callable:
+    """The ILQL advantage-steered chain
+    (reference: trlx/model/nn/ilql_models.py:203-221):
+
+        logits[bigram-forbidden] = -inf
+        adv    = min(target_q1, target_q2) - v
+        pi_top = topk_mask(log_softmax(logits) + beta * adv, top_k)
+        sample ~ softmax(pi_top / temperature)
+
+    ``compute_target_qs(hidden) -> (qs..., vs)`` evaluates the TARGET Q heads
+    and V head on the last hidden state (the trainer closes over the frozen
+    target-head params).
+    """
+    bigram = make_bigram_mask_processor(logit_mask) if logit_mask is not None else None
+
+    def processor(logits: jnp.ndarray, state: dict) -> jnp.ndarray:
+        logits = logits.astype(jnp.float32)
+        if bigram is not None:
+            logits = bigram(logits, state)
+        qs, vs = compute_target_qs(state["hidden"])
+        q = jnp.minimum(qs[0], qs[1]) if len(qs) > 1 else qs[0]
+        adv = q - vs[..., None]
+        pi_beta = jax.nn.log_softmax(logits, axis=-1)
+        pi_top = jnp.maximum(topk_mask(pi_beta + beta * adv, top_k), NEG_INF)
+        return pi_top / temperature
+
+    return processor

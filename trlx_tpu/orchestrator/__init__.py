@@ -1,0 +1,41 @@
+"""Orchestrators: experience generation (reference: trlx/orchestrator/__init__.py)."""
+
+from abc import abstractmethod
+from typing import Dict
+
+# Registry (reference: trlx/orchestrator/__init__.py:9-31)
+_ORCH: Dict[str, type] = {}
+
+
+def register_orchestrator(name=None):
+    """Decorator registering an orchestrator class by (lowercased) name."""
+
+    def register_class(cls, registered_name):
+        _ORCH[registered_name.lower()] = cls
+        return cls
+
+    if isinstance(name, str):
+        return lambda cls: register_class(cls, name)
+    if name is None:
+        return lambda cls: register_class(cls, cls.__name__)
+    cls = name
+    return register_class(cls, cls.__name__)
+
+
+def get_orchestrator(name: str) -> type:
+    name = name.lower()
+    if name in _ORCH:
+        return _ORCH[name]
+    raise Exception(f"Error: Trying to access an orchestrator that has not been registered: {name}")
+
+
+class Orchestrator:
+    """Base orchestrator (reference: trlx/orchestrator/__init__.py:34-46)."""
+
+    def __init__(self, pipeline, rl_model):
+        self.pipeline = pipeline
+        self.rl_model = rl_model
+
+    @abstractmethod
+    def make_experience(self):
+        ...

@@ -1,0 +1,71 @@
+"""Offline (ILQL) orchestrator: dataset → indexed, return-normalized storage.
+
+Mirrors the reference's OfflineOrchestrator
+(reference: trlx/orchestrator/offline_orchestrator.py:7-74): tokenize,
+compute continuation indices (actions) and state indices, normalize returns,
+place the terminal reward on the final action, build the rollout storage.
+"""
+
+import numpy as np
+
+from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.pipeline.ilql_pipeline import ILQLRolloutStorage
+
+
+@register_orchestrator
+class OfflineOrchestrator(Orchestrator):
+    def __init__(self, model, split_token=None):
+        self.model = model
+        self.split_token = split_token
+
+    def make_experience(self, samples, rewards):
+        """(reference: trlx/orchestrator/offline_orchestrator.py:17-74)"""
+        model = self.model
+        if model.tokenizer is not None:
+            input_ids = model.tokenize_ilql(samples)
+        else:
+            input_ids = [np.asarray(s).reshape(-1) for s in samples]
+
+        T = model.config.train.seq_length
+        states_ixs, actions_ixs, dones = [], [], []
+        for s, s_tok in zip(samples, input_ids):
+            # prompt/continuation split: substring `split_token` or a single
+            # BOS token (reference: trlx/orchestrator/offline_orchestrator.py:30-38)
+            if self.split_token and model.tokenizer is not None:
+                prompt_str_len = s.index(self.split_token) + len(self.split_token)
+                prompt_tok_len = len(model.tokenizer(s[:prompt_str_len])["input_ids"])
+            else:
+                prompt_tok_len = 1
+            L = min(len(s_tok), T)
+            a_ixs = np.arange(prompt_tok_len - 1, L - 1)
+            s_ixs = np.arange(prompt_tok_len - 1, L)
+            terminals = np.ones_like(s_ixs)
+            terminals[-1] = 0
+            actions_ixs.append(a_ixs)
+            states_ixs.append(s_ixs)
+            dones.append(terminals)
+
+        if model.tokenizer is not None:
+            prompt = model.tokenizer.decode(input_ids[0][: states_ixs[0][1]])
+            response = model.tokenizer.decode(input_ids[0][states_ixs[0][1] :])
+            print("[Sample example]")
+            print("Prompt: ", prompt)
+            print("Response: ", response)
+
+        sample_lengths = np.asarray([len(x) for x in input_ids], dtype=np.float32)
+        print(f"[Mean reward] {np.mean(np.asarray(rewards, dtype=np.float32)):.2f}")
+        print(f"[Mean sample length] {np.mean(sample_lengths):.2f}")
+
+        # z-score returns; terminal reward on the final action
+        # (reference: trlx/orchestrator/offline_orchestrator.py:63-68)
+        returns = np.asarray(rewards, dtype=np.float32)
+        returns = (returns - returns.mean()) / (returns.std() + 1e-30)
+        reward_rows = [np.zeros(len(a), dtype=np.float32) for a in actions_ixs]
+        for rs, G in zip(reward_rows, returns):
+            rs[-1] = G
+
+        attention_mask = [np.ones(min(len(x), T), dtype=np.int32) for x in input_ids]
+
+        model.store = ILQLRolloutStorage(
+            input_ids, attention_mask, reward_rows, states_ixs, actions_ixs, dones, seq_length=T
+        )

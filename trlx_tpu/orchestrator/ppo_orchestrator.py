@@ -1,0 +1,92 @@
+"""PPO experience generation: the rollout hot loop.
+
+Redesign of the reference's PPOOrchestrator
+(reference: trlx/orchestrator/ppo_orchestrator.py:14-130) around the TPU/host
+boundary:
+
+- `trainer.rollout_generate` — ONE jitted program (prefill + while_loop
+  decode) per batch shape;
+- host: detokenize + user `reward_fn` (arbitrary Python over text — the
+  unavoidable host boundary, reference:
+  trlx/orchestrator/ppo_orchestrator.py:70-73);
+- `trainer.rollout_score` — ONE jitted program computing policy logprobs,
+  values, hydra ref logprobs, and per-token KL-penalty rewards (fusing the
+  reference's separate forward / forward_hydra / reward arithmetic,
+  reference: trlx/orchestrator/ppo_orchestrator.py:79-104).
+
+JAX async dispatch overlaps the next generate with host scoring when the
+loader can prefetch (device work is enqueued, not awaited, until arrays are
+read) — the reference serializes these phases.
+"""
+
+import numpy as np
+
+from trlx_tpu.data import PPORLElement
+from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.utils import Clock
+
+
+@register_orchestrator
+class PPOOrchestrator(Orchestrator):
+    def __init__(self, model, pipeline, reward_fn, metric_fn=None, chunk_size: int = 512):
+        super().__init__(pipeline, model)
+        self.chunk_size = chunk_size
+        self.pipeline_loader = self.pipeline.create_loader(self.chunk_size, shuffle=True)
+        self.pipeline_iterator = iter(self.pipeline_loader)
+
+        # Inject callbacks into the trainer (reference:
+        # trlx/orchestrator/ppo_orchestrator.py:41-43).
+        self.rl_model.orch = self
+        self.rl_model.reward_fn = reward_fn
+        self.rl_model.metric_fn = metric_fn
+
+    def score(self, texts):
+        """User reward on decoded samples
+        (reference: trlx/orchestrator/ppo_orchestrator.py:45-49)."""
+        return self.rl_model.reward_fn(texts)
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
+        """Fill the trainer's rollout store with `num_rollouts` PPORLElements
+        (reference: trlx/orchestrator/ppo_orchestrator.py:50-130)."""
+        ppo_rl_elements = []
+        clock = Clock()
+        while len(ppo_rl_elements) < num_rollouts:
+            try:
+                batch = next(self.pipeline_iterator)
+            except StopIteration:
+                self.pipeline_iterator = iter(self.pipeline_loader)
+                batch = next(self.pipeline_iterator)
+
+            # Device: generate (jitted prefill+decode loop).
+            tokens, mask = self.rl_model.rollout_generate(batch["input_ids"], batch["attention_mask"])
+
+            # Host boundary: decode → user reward_fn.
+            texts_or_tokens = self.rl_model.decode(tokens, mask)
+            scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+
+            # Device: score rollouts (logprobs/values/ref-KL rewards fused).
+            logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
+
+            P = batch["input_ids"].shape[1]
+            q = np.asarray(tokens[:, :P])
+            qmask = np.asarray(mask[:, :P])
+            r = np.asarray(tokens[:, P:])
+            rmask = np.asarray(mask[:, P:])
+            logprobs, values, rewards = np.asarray(logprobs), np.asarray(values), np.asarray(rewards)
+
+            for i in range(q.shape[0]):
+                ppo_rl_elements.append(
+                    PPORLElement(
+                        query_tensor=q[i],
+                        response_tensor=r[i],
+                        logprobs=logprobs[i],
+                        values=values[i],
+                        rewards=rewards[i],
+                        response_mask=rmask[i],
+                        query_mask=qmask[i],
+                    )
+                )
+
+        exp_time = clock.tick()
+        self.rl_model.tracker.log({"exp_time": exp_time, "rollout_mean_score": float(np.mean(scores)), "rollout_mean_kl": float(np.mean(np.asarray(kl).sum(-1)))}, step=iter_count)
+        self.rl_model.push_to_store(ppo_rl_elements)

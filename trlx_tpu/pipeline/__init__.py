@@ -1,0 +1,110 @@
+"""Data pipelines and rollout storage.
+
+Mirrors the reference's pipeline layer (reference: trlx/pipeline/__init__.py)
+minus torch: loaders are plain-Python iterators over numpy, producing
+FIXED-SHAPE pytree batches (XLA static shapes; vs the reference's per-batch
+`pad_sequence` collation, reference: trlx/pipeline/ppo_pipeline.py:39-66).
+Train loaders drop ragged final batches; eval loaders pad the final batch and
+report the valid count.
+"""
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+# Registry (reference: trlx/pipeline/__init__.py:12-34)
+_DATAPIPELINE: Dict[str, type] = {}
+
+
+def register_datapipeline(name=None):
+    """Decorator registering a pipeline class by (lowercased) name."""
+
+    def register_class(cls, registered_name):
+        _DATAPIPELINE[registered_name.lower()] = cls
+        return cls
+
+    if isinstance(name, str):
+        return lambda cls: register_class(cls, name)
+    if name is None:
+        return lambda cls: register_class(cls, cls.__name__)
+    cls = name
+    return register_class(cls, cls.__name__)
+
+
+def get_datapipeline(name: str) -> type:
+    name = name.lower()
+    if name in _DATAPIPELINE:
+        return _DATAPIPELINE[name]
+    raise Exception(f"Error: Trying to access a pipeline that has not been registered: {name}")
+
+
+class BatchLoader:
+    """Minimal DataLoader replacement: shuffled fixed-size batches of pytrees.
+
+    `collate(indices) -> batch` builds one batch from dataset indices. With
+    drop_last=False the final batch is padded by wrapping around (validity is
+    the caller's concern via masks) so every batch has an identical shape —
+    one XLA compilation.
+    """
+
+    def __init__(self, n: int, batch_size: int, collate: Callable, shuffle: bool = False, drop_last: bool = True, seed: int = 0):
+        self.n = n
+        self.batch_size = batch_size
+        self.collate = collate
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        order = np.arange(self.n)
+        if self.shuffle:
+            self._rng.shuffle(order)
+        nb = len(self)
+        for b in range(nb):
+            ix = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if len(ix) < self.batch_size:  # wrap-around pad to static shape
+                reps = int(np.ceil((self.batch_size - len(ix)) / self.n))
+                ix = np.concatenate([ix] + [order] * reps)[: self.batch_size]
+            yield self.collate(ix)
+
+
+class BasePipeline:
+    """Dataset of prompts (reference: trlx/pipeline/__init__.py:37-63)."""
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def __getitem__(self, ix: int) -> Any: ...
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> BatchLoader: ...
+
+
+class BaseRolloutStore:
+    """Rollout storage (reference: trlx/pipeline/__init__.py:66-98)."""
+
+    def __init__(self, capacity: int = -1):
+        self.history: List[Any] = []
+        self.capacity = capacity
+
+    @abstractmethod
+    def push(self, exps: Iterable[Any]): ...
+
+    def clear_history(self):
+        self.history = []
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    def __getitem__(self, ix: int) -> Any:
+        return self.history[ix]
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> BatchLoader: ...

@@ -1,0 +1,74 @@
+"""ILQL rollout storage: fixed-shape padded offline dataset.
+
+Redesign of the reference's six-parallel-tensor-lists storage
+(reference: trlx/pipeline/offline_pipeline.py:38-93): all samples are padded
+ONCE at construction to [T] / [A=T-1] / [A+1] shapes, so batches are pure
+numpy stacks with a single XLA compilation. The reference's padding
+conventions (ixs/dones/rewards zero-padded) are preserved — zero-padded dones
+make terminal_mask kill padded entries in the loss.
+"""
+
+from typing import Iterable, List
+
+import numpy as np
+
+from trlx_tpu.data import ILQLBatch, ILQLElement
+from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
+
+
+class ILQLRolloutStorage(BaseRolloutStore):
+    def __init__(self, input_ids: List, attention_mask: List, rewards: List, states_ixs: List, actions_ixs: List, dones: List, seq_length: int):
+        super().__init__()
+        n = len(input_ids)
+        T = seq_length
+        A = T - 1
+
+        self.input_ids = np.zeros((n, T), dtype=np.int32)
+        self.attention_mask = np.zeros((n, T), dtype=np.int32)
+        self.rewards = np.zeros((n, A), dtype=np.float32)
+        self.states_ixs = np.zeros((n, A + 1), dtype=np.int32)
+        self.actions_ixs = np.zeros((n, A), dtype=np.int32)
+        self.dones = np.zeros((n, A + 1), dtype=np.int32)
+
+        for i in range(n):
+            ids = np.asarray(input_ids[i]).reshape(-1)[:T]
+            L = len(ids)
+            self.input_ids[i, :L] = ids
+            self.attention_mask[i, :L] = np.asarray(attention_mask[i]).reshape(-1)[:L]
+            a = np.asarray(actions_ixs[i]).reshape(-1)[:A]
+            s = np.asarray(states_ixs[i]).reshape(-1)[: A + 1]
+            d = np.asarray(dones[i]).reshape(-1)[: A + 1]
+            r = np.asarray(rewards[i]).reshape(-1)[:A]
+            self.actions_ixs[i, : len(a)] = a
+            self.states_ixs[i, : len(s)] = s
+            self.dones[i, : len(d)] = d
+            self.rewards[i, : len(r)] = r
+
+    def push(self, exps: Iterable):
+        raise NotImplementedError("ILQL storage is static (built once from the offline dataset)")
+
+    def __len__(self):
+        return self.input_ids.shape[0]
+
+    def __getitem__(self, ix: int) -> ILQLElement:
+        return ILQLElement(
+            self.input_ids[ix],
+            self.attention_mask[ix],
+            self.rewards[ix],
+            self.states_ixs[ix],
+            self.actions_ixs[ix],
+            self.dones[ix],
+        )
+
+    def create_loader(self, batch_size: int, shuffle: bool = True, seed: int = 0) -> BatchLoader:
+        def collate(ixs):
+            return ILQLBatch(
+                input_ids=self.input_ids[ixs],
+                attention_mask=self.attention_mask[ixs],
+                rewards=self.rewards[ixs],
+                states_ixs=self.states_ixs[ixs],
+                actions_ixs=self.actions_ixs[ixs],
+                dones=self.dones[ixs],
+            )
+
+        return BatchLoader(len(self), batch_size, collate, shuffle=shuffle, drop_last=True, seed=seed)

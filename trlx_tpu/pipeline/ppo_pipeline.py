@@ -1,0 +1,42 @@
+"""PPO rollout storage: fixed-shape numpy ring of PPORLElements.
+
+Redesign of the reference's PPORolloutStorage
+(reference: trlx/pipeline/ppo_pipeline.py:11-68). Elements arrive already
+padded to static [P] / [R] shapes (queries left-padded, responses
+right-padded — the reference's exact padding discipline, reference:
+trlx/pipeline/ppo_pipeline.py:39-66 — but enforced at rollout time, so
+collation is a plain stack with no per-batch pad_sequence).
+"""
+
+from typing import Iterable, List
+
+import numpy as np
+
+from trlx_tpu.data import PPORLBatch, PPORLElement
+from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    def __init__(self, pad_token_id: int = 0):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.history: List[PPORLElement] = []
+
+    def push(self, exps: Iterable[PPORLElement]):
+        self.history += list(exps)
+
+    def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> BatchLoader:
+        history = self.history
+
+        def collate(ixs):
+            return PPORLBatch(
+                query_tensors=np.stack([history[i].query_tensor for i in ixs]),
+                response_tensors=np.stack([history[i].response_tensor for i in ixs]),
+                logprobs=np.stack([history[i].logprobs for i in ixs]),
+                values=np.stack([history[i].values for i in ixs]),
+                rewards=np.stack([history[i].rewards for i in ixs]),
+                response_mask=np.stack([history[i].response_mask for i in ixs]),
+                query_mask=np.stack([history[i].query_mask for i in ixs]),
+            )
+
+        return BatchLoader(len(history), batch_size, collate, shuffle=shuffle, drop_last=True, seed=seed)

@@ -1,0 +1,71 @@
+"""Prompt pipeline: text (or raw-token) prompts → fixed-shape left-padded batches.
+
+Redesign of the reference's PromptPipeline
+(reference: trlx/pipeline/offline_pipeline.py:12-35): tokenization happens
+once at construction; every batch has the SAME [batch, max_prompt_length]
+shape, left-padded (the decode engine samples at the last position), so the
+whole rollout path compiles exactly once.
+"""
+
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from trlx_tpu.pipeline import BasePipeline, BatchLoader, register_datapipeline
+
+
+@register_datapipeline
+class PromptPipeline(BasePipeline):
+    """Tokenizes and left-pads a list of prompts.
+
+    :param prompts: list of strings (tokenizer mode) or list of int sequences
+        (tensor-prompt mode, like the reference's tokenizer-less randomwalks
+        path at trlx/pipeline/offline_pipeline.py:30-33).
+    :param tokenizer: HF tokenizer or None.
+    :param max_prompt_length: static prompt length; longer prompts truncate
+        from the LEFT (keep the most recent context), shorter ones left-pad.
+    """
+
+    def __init__(self, prompts: Iterable, tokenizer=None, max_prompt_length: int = 64, add_bos: bool = True):
+        self.tokenizer = tokenizer
+        self.max_prompt_length = max_prompt_length
+
+        if tokenizer is not None:
+            # BOS prepended like the reference's tokenize()
+            # (reference: trlx/model/accelerate_base_model.py:93-103).
+            token_lists = []
+            for text in prompts:
+                ids = tokenizer(text, add_special_tokens=False)["input_ids"]
+                if add_bos and tokenizer.bos_token_id is not None:
+                    ids = [tokenizer.bos_token_id] + ids
+                token_lists.append(ids[-max_prompt_length:])
+            pad_id = tokenizer.pad_token_id if tokenizer.pad_token_id is not None else 0
+        else:
+            token_lists = [list(np.asarray(p).reshape(-1)) for p in prompts]
+            token_lists = [t[-max_prompt_length:] for t in token_lists]
+            pad_id = 0
+
+        n = len(token_lists)
+        P = max_prompt_length
+        self.input_ids = np.full((n, P), pad_id, dtype=np.int32)
+        self.attention_mask = np.zeros((n, P), dtype=np.int32)
+        for i, ids in enumerate(token_lists):
+            L = len(ids)
+            self.input_ids[i, P - L :] = ids
+            self.attention_mask[i, P - L :] = 1
+        self.pad_id = pad_id
+
+    def __len__(self) -> int:
+        return self.input_ids.shape[0]
+
+    def __getitem__(self, ix: int):
+        return {"input_ids": self.input_ids[ix], "attention_mask": self.attention_mask[ix]}
+
+    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0) -> BatchLoader:
+        def collate(ixs):
+            return {
+                "input_ids": self.input_ids[ixs],
+                "attention_mask": self.attention_mask[ixs],
+            }
+
+        return BatchLoader(len(self), batch_size, collate, shuffle=shuffle, drop_last=drop_last, seed=seed)

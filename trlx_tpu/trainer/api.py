@@ -1,0 +1,100 @@
+"""`train()` dispatch: reward_fn → online PPO, dataset → offline ILQL
+(reference: trlx/trlx.py:13-93)."""
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+from trlx_tpu.data.configs import TRLConfig
+
+# Importing these modules populates the registries (the reference does the
+# same via package imports, reference: trlx/model/__init__.py:17-36).
+import trlx_tpu.trainer.ppo  # noqa: F401
+import trlx_tpu.orchestrator.ppo_orchestrator  # noqa: F401
+import trlx_tpu.pipeline.prompt_pipeline  # noqa: F401
+
+try:  # ILQL lands as its own module; keep PPO usable while it builds out
+    import trlx_tpu.trainer.ilql  # noqa: F401
+    import trlx_tpu.orchestrator.offline_orchestrator  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+from trlx_tpu.orchestrator import get_orchestrator
+from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+from trlx_tpu.trainer import get_model
+
+_CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "configs")
+
+
+def default_config(name: str) -> TRLConfig:
+    return TRLConfig.load_yaml(os.path.join(_CONFIG_DIR, f"{name}_config.yml"))
+
+
+def train(
+    model_path: Optional[str] = None,
+    reward_fn: Optional[Callable] = None,
+    dataset: Optional[Tuple[List[str], List[float]]] = None,
+    prompts: Optional[List] = None,
+    eval_prompts: Optional[List] = None,
+    metric_fn: Optional[Callable] = None,
+    config: Optional[TRLConfig] = None,
+    split_token: Optional[str] = None,
+    logit_mask=None,
+):
+    if reward_fn is not None:
+        # ---------------- online PPO (reference: trlx/trlx.py:38-59)
+        if config is None:
+            config = default_config("ppo")
+        if model_path:
+            config.model.model_path = model_path
+
+        model = get_model(config.model.model_type)(
+            config, reward_fn=reward_fn, metric_fn=metric_fn, logit_mask=logit_mask
+        )
+
+        batch_size = config.train.batch_size
+        if prompts is None:
+            assert model.tokenizer is not None, "default prompts need a tokenizer"
+            prompts = [model.tokenizer.bos_token] * batch_size
+
+        pipeline = PromptPipeline(prompts, model.tokenizer, max_prompt_length=model.prompt_length)
+        orch = get_orchestrator(config.train.orchestrator)(
+            model, pipeline, reward_fn=reward_fn, metric_fn=metric_fn, chunk_size=config.method.chunk_size
+        )
+        orch.make_experience(config.method.num_rollouts)
+
+        eval_pipeline = PromptPipeline(
+            eval_prompts if eval_prompts is not None else prompts,
+            model.tokenizer,
+            max_prompt_length=model.prompt_length,
+        )
+        model.add_eval_pipeline(eval_pipeline)
+
+    elif dataset is not None:
+        # ---------------- offline ILQL (reference: trlx/trlx.py:61-87)
+        samples, rewards = dataset
+        if config is None:
+            config = default_config("ilql")
+        if model_path:
+            config.model.model_path = model_path
+
+        if len(samples) != len(rewards):
+            raise ValueError(f"Number of samples {len(samples)} should match the number of rewards {len(rewards)}")
+
+        model = get_model(config.model.model_type)(
+            config, metric_fn=metric_fn, logit_mask=logit_mask
+        )
+        orch = get_orchestrator(config.train.orchestrator)(model, split_token=split_token)
+        orch.make_experience(samples, rewards)
+
+        eval_pipeline = PromptPipeline(
+            eval_prompts if eval_prompts is not None else ([model.tokenizer.bos_token] * config.train.batch_size if model.tokenizer else [[0]] * config.train.batch_size),
+            model.tokenizer,
+            max_prompt_length=model.prompt_length,
+        )
+        model.add_eval_pipeline(eval_pipeline)
+
+    else:
+        raise ValueError("Either reward_fn or dataset must be given (reference: trlx/trlx.py:89-90)")
+
+    model.learn()
+    return model

@@ -1,0 +1,216 @@
+"""ILQL trainer: offline Q-learning with advantage-steered decoding.
+
+TPU redesign of AccelerateILQLModel
+(reference: trlx/model/accelerate_ilql_model.py:13-181) +
+CausalLMWithValueHeads' target-head machinery
+(reference: trlx/model/nn/ilql_models.py:31-160):
+
+- target Q heads are a frozen param subtree in TrainState.extras; Polyak sync
+  is a jitted tree blend — no GatheredParameters/rank-0 dance, sharding-safe
+  by construction (vs reference: trlx/model/nn/ilql_models.py:148-158);
+- the whole loss (double-Q TD + expectile V + CQL + AWAC) is one pjit'd step;
+- eval decoding runs the compiled while_loop sampler with the ILQL advantage
+  processor instead of the reference's per-token Python loop
+  (reference: trlx/model/nn/ilql_models.py:162-251).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data import ILQLBatch
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.heads import LMWithILQLHeads
+from trlx_tpu.ops.generate import make_generate_fn
+from trlx_tpu.ops.ilql_loss import ilql_loss
+from trlx_tpu.ops.modeling import topk_mask
+from trlx_tpu.ops.sampling import NEG_INF, GenerateConfig
+from trlx_tpu.trainer import register_model
+from trlx_tpu.trainer.base import JaxBaseTrainer
+
+
+@register_model("ilql")
+@register_model("ILQLModel")
+@register_model("AccelerateILQLModel")
+class ILQLTrainer(JaxBaseTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        m = config.method
+
+        gen_kwargs = dict(m.gen_kwargs)
+        self.beta = float(gen_kwargs.pop("beta", m.betas[0] if m.betas else 1.0))
+        self.decode_top_k = int(gen_kwargs.pop("top_k", 20))
+        self.decode_temperature = float(gen_kwargs.pop("temperature", 1.0))
+        self.prompt_length = int(gen_kwargs.pop("prompt_length", 0)) or max(
+            config.train.seq_length - int(gen_kwargs.get("max_new_tokens", config.train.seq_length // 2)),
+            1,
+        )
+        if "max_new_tokens" not in gen_kwargs and "max_length" not in gen_kwargs:
+            gen_kwargs["max_length"] = config.train.seq_length
+        self.gen_cfg = GenerateConfig.from_gen_kwargs(
+            gen_kwargs,
+            prompt_len=self.prompt_length,
+            pad_token_id=self.pad_token_id,
+            eos_token_id=self.eos_token_id,
+        )
+
+        self._generate_fn = make_generate_fn(
+            self.model, self.gen_cfg, processor=self._make_ilql_processor(), carry_keys=("qs", "vs")
+        )
+        self.train_step = self.build_train_step()
+        self._sync_fn = jax.jit(self._polyak_sync, donate_argnums=(1,))
+
+    # ----------------------------------------------------------------- setup
+
+    @property
+    def pad_token_id(self) -> int:
+        if self.tokenizer is not None and self.tokenizer.pad_token_id is not None:
+            return int(self.tokenizer.pad_token_id)
+        return 0
+
+    @property
+    def eos_token_id(self):
+        if self.tokenizer is not None:
+            return self.tokenizer.eos_token_id
+        return self.config.model.model_arch.get("eos_token_id")
+
+    def get_arch(self, config: TRLConfig):
+        from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
+
+        lm_cfg = build_lm_config(config)
+        model = LMWithILQLHeads(lm_cfg, two_qs=config.method.two_qs)
+        params = load_or_init_params(model, config, self.rng)
+        return model, params
+
+    def make_extras(self, init_params):
+        """Frozen target-Q heads start as copies of the online heads
+        (reference: trlx/model/nn/ilql_models.py:79-87)."""
+        extras = {"q1_head": jax.tree_util.tree_map(jnp.copy, init_params["q1_head"])}
+        if self.config.method.two_qs:
+            extras["q2_head"] = jax.tree_util.tree_map(jnp.copy, init_params["q2_head"])
+        return extras
+
+    # ------------------------------------------------------------ generation
+
+    def _make_ilql_processor(self):
+        """Advantage-steered decode chain
+        (reference: trlx/model/nn/ilql_models.py:203-221). Q/V come from the
+        generate loop's carry (heads evaluated in the same forward pass);
+        qs carry holds the TARGET heads because rollout_generate swaps them
+        into the param tree."""
+        beta, top_k, temperature = self.beta, self.decode_top_k, self.decode_temperature
+        logit_mask = jnp.asarray(self.logit_mask) if self.logit_mask is not None else None
+
+        def processor(logits, state):
+            logits = logits.astype(jnp.float32)
+            if logit_mask is not None:
+                forbidden = logit_mask[state["last_token"]]
+                logits = jnp.where(forbidden, NEG_INF, logits)
+            qs = state["carry"]["qs"]
+            vs = state["carry"]["vs"]
+            q = jnp.minimum(qs[0], qs[1]) if len(qs) > 1 else qs[0]
+            adv = q.astype(jnp.float32) - vs.astype(jnp.float32)[..., None]
+            pi_beta = jax.nn.log_softmax(logits, axis=-1)
+            pi_top = jnp.maximum(topk_mask(pi_beta + beta * adv, top_k), NEG_INF)
+            return pi_top / temperature
+
+        return processor
+
+    def rollout_generate(self, input_ids, attention_mask):
+        batch = self.put_batch({"i": input_ids, "m": attention_mask})
+        # Swap TARGET Q heads into the applied params: decode steers by the
+        # target network (reference: trlx/model/nn/ilql_models.py:203-206).
+        params = {**self.state.params, **self.state.extras}
+        return self._generate_fn({"params": params}, batch["i"], batch["m"], self.next_rng())
+
+    # ------------------------------------------------------------ train step
+
+    def build_train_step(self):
+        m = self.config.method
+        model = self.model
+        optimizer = self.optimizer
+        schedule = self.schedule
+
+        def loss_fn(params, extras, batch: ILQLBatch):
+            out = model.apply(
+                {"params": params},
+                batch.input_ids,
+                batch.attention_mask,
+                states_ixs=batch.states_ixs,
+                actions_ixs=batch.actions_ixs,
+            )
+            hs_actions = jnp.take_along_axis(out["hidden"], batch.actions_ixs[..., None], axis=1)
+            target_qs = model.apply({"params": extras}, hs_actions, method="compute_qs")
+            return ilql_loss(
+                out["logits"].astype(jnp.float32),
+                out["qs"],
+                target_qs,
+                out["vs"],
+                batch.input_ids,
+                batch.attention_mask,
+                batch.actions_ixs,
+                batch.rewards,
+                batch.dones,
+                gamma=m.gamma,
+                tau=m.tau,
+                cql_scale=m.cql_scale,
+                awac_scale=m.awac_scale,
+            )
+
+        def train_step(state, batch: ILQLBatch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, state.extras, batch)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            stats = dict(stats)
+            stats["grad_norm"] = optax.global_norm(grads)
+            stats["learning_rate"] = schedule(state.step)
+            return state.replace(step=state.step + 1, params=params, opt_state=opt_state), stats
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- callbacks
+
+    def _polyak_sync(self, params, extras, alpha: float):
+        """target ← α·online + (1−α)·target
+        (reference: trlx/model/nn/ilql_models.py:131-146)."""
+        online = {k: params[k] for k in extras}
+        return jax.tree_util.tree_map(lambda q, t: alpha * q + (1 - alpha) * t, online, extras)
+
+    def post_backward_callback(self, stats=None):
+        """(reference: trlx/model/accelerate_ilql_model.py:46-48)"""
+        if self.iter_count % self.config.method.steps_for_target_q_sync == 0:
+            new_extras = self._sync_fn(self.state.params, self.state.extras, self.config.method.alpha)
+            self.state = self.state.replace(extras=new_extras)
+
+    def post_epoch_callback(self):
+        pass
+
+    def prepare_learning(self):
+        """(reference: trlx/model/accelerate_ilql_model.py:158-181)"""
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
+        self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        self.n_updates_per_batch = 1
+        self.total_steps = min(
+            self.config.train.epochs * len(self.train_dataloader),
+            self.config.train.total_steps,
+        )
+
+    # -------------------------------------------------------------- tokenize
+
+    def tokenize_ilql(self, texts):
+        """BOS + text + EOS (reference: trlx/model/accelerate_ilql_model.py:34-44)."""
+        out = []
+        for text in texts:
+            if not isinstance(text, str):
+                out.append(np.asarray(text).reshape(-1))
+                continue
+            ids = self.tokenizer(text, add_special_tokens=False)["input_ids"]
+            if self.tokenizer.bos_token_id is not None:
+                ids = [self.tokenizer.bos_token_id] + ids
+            if self.tokenizer.eos_token_id is not None:
+                ids = ids + [self.tokenizer.eos_token_id]
+            out.append(np.asarray(ids[: self.config.train.seq_length], dtype=np.int32))
+        return out

@@ -1,0 +1,245 @@
+"""PPO trainer: KL controllers, fused rollout-scoring and train-step programs.
+
+TPU redesign of AcceleratePPOModel
+(reference: trlx/model/accelerate_ppo_model.py:12-184). The whole PPO update
+— GAE, whitening, policy forward, clipped losses, grad, optimizer, LR
+schedule — is ONE pjit'd program with donated state; rollout scoring (policy
+forward + hydra ref logits + KL-penalty rewards) is another. The KL
+controller stays host-side Python, exactly as stateful-scalar logic should.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from trlx_tpu.data import PPORLBatch
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.heads import LMWithValueHead, extract_branch_params
+from trlx_tpu.models.lm import LMConfig
+from trlx_tpu.ops.generate import make_generate_fn
+from trlx_tpu.ops.modeling import logprobs_from_logits
+from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
+from trlx_tpu.ops.sampling import GenerateConfig
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.trainer import register_model
+from trlx_tpu.trainer.base import JaxBaseTrainer
+
+
+class AdaptiveKLController:
+    """Proportional KL-coefficient controller
+    (reference: trlx/model/accelerate_ppo_model.py:12-22)."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: int):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current: float, n_steps: int):
+        proportional_error = np.clip(current / self.target - 1, -0.2, 0.2)
+        mult = 1 + proportional_error * n_steps / self.horizon
+        self.value *= mult
+
+
+class FixedKLController:
+    """(reference: trlx/model/accelerate_ppo_model.py:25-32)"""
+
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current: float, n_steps: int):
+        pass
+
+
+@register_model("ppo")
+@register_model("AcceleratePPOModel")  # reference-compatible registry name
+@register_model("PPOTrainer")
+class PPOTrainer(JaxBaseTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        m = config.method
+
+        self.store = PPORolloutStorage(self.pad_token_id)
+
+        if m.target is not None:
+            self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
+        else:
+            self.kl_ctl = FixedKLController(m.init_kl_coef)
+
+        # Static decode shapes: prompt length + new tokens == seq_length.
+        gen_kwargs = dict(m.gen_kwargs)
+        self.prompt_length = int(gen_kwargs.pop("prompt_length", 0)) or max(
+            config.train.seq_length - int(gen_kwargs.get("max_new_tokens", config.train.seq_length // 2)),
+            1,
+        )
+        self.gen_cfg = GenerateConfig.from_gen_kwargs(
+            gen_kwargs,
+            prompt_len=self.prompt_length,
+            pad_token_id=self.pad_token_id,
+            eos_token_id=self.eos_token_id,
+        )
+        self.response_length = self.gen_cfg.max_new_tokens
+
+        # Optional bigram logit mask constrains generation (tensor-prompt
+        # tasks like randomwalks; the reference only supports this in ILQL
+        # decode, reference: trlx/model/nn/ilql_models.py:211-212).
+        processor = None
+        if self.logit_mask is not None:
+            from trlx_tpu.ops.sampling import make_bigram_mask_processor, process_logits_default
+
+            bigram = make_bigram_mask_processor(self.logit_mask)
+            gcfg = self.gen_cfg
+
+            def processor(logits, state):
+                return process_logits_default(bigram(logits, state), gcfg, state["step"])
+
+        self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
+        self._score_fn = jax.jit(partial(self._rollout_score_impl, prompt_length=self.prompt_length))
+        self.train_step = self.build_train_step()
+
+    # ----------------------------------------------------------------- setup
+
+    @property
+    def pad_token_id(self) -> int:
+        if self.tokenizer is not None and self.tokenizer.pad_token_id is not None:
+            return int(self.tokenizer.pad_token_id)
+        return 0
+
+    @property
+    def eos_token_id(self):
+        if self.tokenizer is not None:
+            return self.tokenizer.eos_token_id
+        return self.config.model.model_arch.get("eos_token_id")
+
+    def get_arch(self, config: TRLConfig):
+        """Build LMWithValueHead (+ hydra branch point) — the counterpart of
+        GPTHydraHeadWithValueModel (reference: trlx/model/nn/ppo_models.py:315-346)."""
+        from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
+
+        lm_cfg = build_lm_config(config)
+        k = config.model.num_layers_unfrozen
+        branch_layer = lm_cfg.n_layer - k if k > 0 else -1
+        model = LMWithValueHead(lm_cfg, branch_layer=branch_layer)
+        params = load_or_init_params(model, config, self.rng)
+        return model, params
+
+    def make_extras(self, init_params):
+        """The frozen ref branch = initial top-k blocks + head
+        (functional hydra; reference deep-copies modules instead at
+        trlx/model/nn/ppo_models.py:336-346). Fully-unfrozen models keep a
+        complete frozen param copy (the reference's separate ref model path,
+        reference: trlx/orchestrator/ppo_orchestrator.py:38-39)."""
+        if self.model.branch_layer >= 0:
+            return extract_branch_params(init_params, self.model.cfg, self.model.branch_layer)
+        return jax.tree_util.tree_map(jnp.copy, init_params)
+
+    # --------------------------------------------------------------- rollout
+
+    def rollout_generate(self, input_ids, attention_mask):
+        batch = self.put_batch({"i": input_ids, "m": attention_mask})
+        return self._generate_fn({"params": self.state.params}, batch["i"], batch["m"], self.next_rng())
+
+    def _rollout_score_impl(self, params, extras, tokens, mask, scores, kl_coef, *, prompt_length: int):
+        P = prompt_length
+        out = self.model.apply({"params": params}, tokens, mask, collect_branch_hidden=True)
+        logits = out["logits"].astype(jnp.float32)
+        if self.model.branch_layer >= 0:
+            ref_logits = self.model.apply(
+                {"params": extras}, out["branch_hidden"], mask, method="forward_branch"
+            ).astype(jnp.float32)
+        else:
+            ref_logits = self.model.apply({"params": extras}, tokens, mask)["logits"].astype(jnp.float32)
+
+        logprobs = logprobs_from_logits(logits[:, :-1], tokens[:, 1:])
+        ref_logprobs = logprobs_from_logits(ref_logits[:, :-1], tokens[:, 1:])
+        # Response region, state-before-token convention [P-1, P+R-1)
+        # (reference: trlx/orchestrator/ppo_orchestrator.py:94-98).
+        lp = logprobs[:, P - 1 :]
+        rlp = ref_logprobs[:, P - 1 :]
+        values = out["values"].astype(jnp.float32)[:, P - 1 : -1]
+        rmask = mask[:, P:]
+        rewards, kl = kl_penalty_rewards(lp, rlp, rmask, scores, kl_coef)
+        return lp, values, rewards, kl
+
+    def rollout_score(self, tokens, mask, scores):
+        scores = self.put_batch(np.asarray(scores, dtype=np.float32))
+        return self._score_fn(
+            self.state.params,
+            self.state.extras,
+            tokens,
+            mask,
+            scores,
+            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+        )
+
+    # ------------------------------------------------------------ train step
+
+    def build_train_step(self):
+        m = self.config.method
+        model = self.model
+        optimizer = self.optimizer
+        P = self.prompt_length
+
+        def loss_fn(params, batch: PPORLBatch):
+            all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
+            all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
+            out = model.apply({"params": params}, all_ids, all_mask)
+            logits = out["logits"].astype(jnp.float32)
+            logprobs = logprobs_from_logits(logits[:, :-1], all_ids[:, 1:])
+            lp = logprobs[:, P - 1 :]
+            vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
+            return ppo_loss(
+                lp,
+                vpred,
+                batch.logprobs,
+                batch.values,
+                batch.rewards,
+                batch.response_mask,
+                gamma=m.gamma,
+                lam=m.lam,
+                cliprange=m.cliprange,
+                cliprange_value=m.cliprange_value,
+                vf_coef=m.vf_coef,
+            )
+
+        schedule = self.schedule
+
+        def train_step(state, batch: PPORLBatch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            stats = dict(stats)
+            stats["grad_norm"] = optax.global_norm(grads)
+            stats["learning_rate"] = schedule(state.step)
+            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+            return new_state, stats
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- callbacks
+
+    def post_backward_callback(self, stats=None):
+        """KL-coefficient update from the policy-vs-rollout KL
+        (reference: trlx/model/accelerate_ppo_model.py:163-165)."""
+        if stats and "mean_kl" in stats:
+            self.kl_ctl.update(stats["mean_kl"], self.config.train.batch_size)
+
+    def post_epoch_callback(self):
+        """Alternate back to rollout
+        (reference: trlx/model/accelerate_ppo_model.py:157-161)."""
+        self.store.clear_history()
+        self.orch.make_experience(self.config.method.num_rollouts, self.iter_count)
+        self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+
+    def prepare_learning(self):
+        """(reference: trlx/model/accelerate_ppo_model.py:167-184)"""
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
+        self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        self.n_updates_per_batch = self.config.method.ppo_epochs
+        self.total_steps = min(
+            self.config.train.epochs * self.n_updates_per_batch * len(self.train_dataloader),
+            self.config.train.total_steps,
+        )

@@ -1,0 +1,86 @@
+"""Experiment tracking: wandb when available, JSONL + stdout otherwise.
+
+The reference is wandb-centric through Accelerate
+(reference: trlx/model/accelerate_base_model.py:31,66-79,244). This container
+has no wandb and no egress, so the tracker degrades gracefully: rank-0 writes
+`<checkpoint_dir>/metrics.jsonl` and prints compact lines. The `debug` env var
+disables tracking entirely, matching the reference's behavior
+(reference: trlx/model/accelerate_base_model.py:72-79).
+"""
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+try:
+    import wandb  # type: ignore
+
+    _HAS_WANDB = True
+except Exception:
+    wandb = None
+    _HAS_WANDB = False
+
+from trlx_tpu.parallel.mesh import is_main_process
+
+
+class Tracker:
+    def __init__(
+        self,
+        project_name: str,
+        config: Optional[Dict[str, Any]] = None,
+        run_name: Optional[str] = None,
+        entity_name: Optional[str] = None,
+        log_dir: str = "ckpts",
+    ):
+        self.enabled = is_main_process() and "debug" not in os.environ
+        self._wandb = None
+        self._file = None
+        if not self.enabled:
+            return
+        if _HAS_WANDB:
+            mode = "disabled" if "debug" in os.environ else "online"
+            self._wandb = wandb.init(
+                project=project_name, name=run_name, entity=entity_name, config=config, mode=mode
+            )
+        os.makedirs(log_dir, exist_ok=True)
+        self._file = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        if config:
+            self._file.write(json.dumps({"_config": {k: str(v) for k, v in config.items()}}) + "\n")
+
+    def log(self, stats: Dict[str, Any], step: Optional[int] = None):
+        if not self.enabled:
+            return
+        scalars = {}
+        for k, v in stats.items():
+            try:
+                scalars[k] = float(v)
+            except (TypeError, ValueError):
+                scalars[k] = str(v)
+        if self._wandb is not None:
+            self._wandb.log(scalars, step=step)
+        rec = {"step": step, "t": round(time.time(), 3), **scalars}
+        self._file.write(json.dumps(rec) + "\n")
+        self._file.flush()
+
+    def log_table(self, name: str, columns, rows, step: Optional[int] = None):
+        """Sample tables (≈ wandb.Table at
+        reference: trlx/model/accelerate_base_model.py:186-197)."""
+        if not self.enabled:
+            return
+        if self._wandb is not None:
+            self._wandb.log({name: wandb.Table(columns=list(columns), data=list(rows))}, step=step)
+        preview = rows[:4]
+        print(f"[{name}] step={step}", file=sys.stderr)
+        for row in preview:
+            cells = " | ".join(str(c)[:60] for c in row)
+            print(f"  {cells}", file=sys.stderr)
+        self._file.write(json.dumps({"table": name, "step": step, "columns": list(columns), "rows": [[str(c) for c in r] for r in rows[:32]]}) + "\n")
+        self._file.flush()
+
+    def finish(self):
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._file is not None:
+            self._file.close()
