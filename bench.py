@@ -1,0 +1,124 @@
+"""Benchmark: PPO iteration throughput (samples/sec/chip) on real hardware.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures the full PPO cadence — compiled rollout generation (prefill +
+while_loop decode), fused rollout scoring, and ppo_epochs donated train steps
+— on a GPT-J-family model sized to the chip (BENCH_PRESET env: tiny|small|
+medium). The reference publishes no numbers (BASELINE.md); the recorded
+Accelerate-GPU comparison baseline is 1.0 samples/sec/chip until a measured
+reference lands, so vs_baseline == value.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+PRESETS = {
+    # name: (n_layer, d_model, n_head, vocab, prompt_len, new_tokens, batch)
+    "tiny": (2, 256, 8, 1024, 16, 32, 16),
+    "small": (8, 1024, 16, 50400, 16, 32, 16),
+    "medium": (16, 2048, 16, 50400, 16, 32, 8),
+}
+
+
+def main():
+    preset = os.environ.get("BENCH_PRESET", "small")
+    n_layer, d_model, n_head, vocab, P, R, B = PRESETS[preset]
+
+    import jax
+
+    from trlx_tpu.data import PPORLBatch
+    from trlx_tpu.trainer.api import default_config
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    config = default_config("ppo")
+    config.model.model_path = ""
+    config.model.tokenizer_path = ""
+    config.model.num_layers_unfrozen = max(n_layer // 2, 1)
+    config.model.model_arch = {
+        "vocab_size": vocab,
+        "n_layer": n_layer,
+        "n_head": n_head,
+        "d_model": d_model,
+        "max_position": 2048,
+        "eos_token_id": 0,
+        "pos_type": "rotary",
+        "rotary_dim": 64 if d_model // n_head >= 64 else d_model // n_head,
+        "parallel_residual": True,
+        "fused_qkv": False,
+        "qkv_bias": False,
+        "out_bias": False,
+        "tie_word_embeddings": False,
+        "extra": {"lm_head_bias": True},
+    }
+    config.train.batch_size = B
+    config.train.seq_length = P + R
+    config.train.mesh = [-1, 1, 1, 1]
+    config.method.gen_kwargs = {
+        "prompt_length": P,
+        "max_new_tokens": R,
+        "min_new_tokens": R,  # fixed-length decode: measure the full loop
+        "do_sample": True,
+        "top_k": 0,
+        "top_p": 1.0,
+    }
+    config.method.chunk_size = B
+    config.method.num_rollouts = B
+    config.method.ppo_epochs = 4
+
+    trainer = PPOTrainer(config)
+    n_chips = jax.device_count()
+    rng = np.random.default_rng(0)
+    prompt_ids = rng.integers(2, vocab, size=(B, P)).astype(np.int32)
+    prompt_mask = np.ones((B, P), dtype=np.int32)
+
+    def ppo_iteration():
+        tokens, mask = trainer.rollout_generate(prompt_ids, prompt_mask)
+        scores = rng.normal(size=(B,)).astype(np.float32)
+        logprobs, values, rewards, _ = trainer.rollout_score(tokens, mask, scores)
+        batch = trainer.put_batch(
+            PPORLBatch(
+                query_tensors=np.asarray(tokens[:, :P]),
+                response_tensors=np.asarray(tokens[:, P:]),
+                logprobs=np.asarray(logprobs),
+                values=np.asarray(values),
+                rewards=np.asarray(rewards),
+                response_mask=np.asarray(mask[:, P:]),
+                query_mask=np.asarray(mask[:, :P]),
+            )
+        )
+        for _ in range(config.method.ppo_epochs):
+            trainer.state, stats = trainer.train_step(trainer.state, batch)
+        jax.block_until_ready(trainer.state.params)
+
+    # warmup / compile
+    ppo_iteration()
+
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    t0 = time.time()
+    for _ in range(iters):
+        ppo_iteration()
+    elapsed = time.time() - t0
+
+    samples = iters * B
+    sps_per_chip = samples / elapsed / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"ppo_samples_per_sec_per_chip[{preset},gptj-arch,l{n_layer},d{d_model},seq{P+R}]",
+                "value": round(sps_per_chip, 3),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(sps_per_chip, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""End-to-end smokes: a few PPO and ILQL steps on the randomwalks task
+(the reference's de-facto integration suite is examples/, SURVEY.md §4 —
+here it's in CI)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def shrink(config):
+    config.train.total_steps = 6
+    config.train.epochs = 2
+    config.train.batch_size = 16
+    config.train.eval_interval = 4
+    config.method.num_rollouts = 16 if hasattr(config.method, "num_rollouts") else None
+    if hasattr(config.method, "chunk_size"):
+        config.method.chunk_size = 16
+    return config
+
+
+def test_ppo_e2e_smoke(task, tmp_path):
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.iter_count >= 6
+    assert len(model.store) > 0
+
+
+def test_ilql_e2e_smoke(task, tmp_path):
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ilql", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    lengths = metric_fn(walks)["lengths"]
+    model = trlx_tpu.train(
+        dataset=(walks, lengths),
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.iter_count >= 6
+
+
+def test_checkpoint_save_load(task, tmp_path):
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.total_steps = 2
+    config.train.checkpoint_dir = str(tmp_path / "ck")
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]], metric_fn=metric_fn,
+        config=config, logit_mask=logit_mask,
+    )
+    import jax
+
+    step_before = int(jax.device_get(model.state.step))
+    model.load()
+    assert int(jax.device_get(model.state.step)) == step_before

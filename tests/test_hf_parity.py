@@ -1,0 +1,62 @@
+"""HF numerical parity: convert locally-instantiated (random) torch models
+and compare logits — validates the weight conversion + architecture fidelity
+that reward parity depends on (SURVEY.md §7 "hard parts" #1), with zero
+downloads."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from trlx_tpu.models import TransformerLM
+from trlx_tpu.models.hf_import import (
+    convert_gpt2,
+    convert_gptj,
+    convert_neox,
+    lm_config_from_hf,
+)
+
+
+def compare(hf_model, converter, atol=2e-4):
+    hf_model.eval()
+    cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    trunk = converter(sd, cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.as_tensor(ids)).logits.numpy()
+
+    model = TransformerLM(cfg)
+    out = model.apply({"params": trunk}, jnp.asarray(ids), jnp.ones(ids.shape, jnp.int32))
+    got = np.asarray(out["logits"], dtype=np.float32)
+    np.testing.assert_allclose(got, ref, atol=atol, rtol=1e-3)
+
+
+def test_gpt2_parity():
+    config = transformers.GPT2Config(n_layer=2, n_head=4, n_embd=64, vocab_size=128, n_positions=64)
+    compare(transformers.GPT2LMHeadModel(config), convert_gpt2)
+
+
+def test_gptj_parity():
+    config = transformers.GPTJConfig(
+        n_layer=2, n_head=4, n_embd=64, vocab_size=128, n_positions=64, rotary_dim=8
+    )
+    compare(transformers.GPTJForCausalLM(config), convert_gptj)
+
+
+def test_neox_parity():
+    config = transformers.GPTNeoXConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        hidden_size=64,
+        intermediate_size=256,
+        vocab_size=128,
+        max_position_embeddings=64,
+        rotary_pct=0.25,
+    )
+    compare(transformers.GPTNeoXForCausalLM(config), convert_neox)

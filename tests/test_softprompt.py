@@ -1,0 +1,66 @@
+"""Soft-prompt capability: prefix changes outputs, only prefix+v_head train,
+generation accounts for the prefix (capability parity with the fork's
+SoftEmbedding, reference: trlx/model/accelerate_ppo_softprompt_model.py:26-81)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models import LMConfig, LMWithValueHead
+from trlx_tpu.ops.generate import make_generate_fn
+from trlx_tpu.ops.sampling import GenerateConfig
+
+
+def build(n_soft=4):
+    cfg = LMConfig(vocab_size=29, n_layer=2, n_head=2, d_model=32, max_position=64,
+                   dtype="float32", n_soft_tokens=n_soft)
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 6), 1, cfg.vocab_size)
+    mask = jnp.ones((2, 6), jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+    return cfg, model, params, ids, mask
+
+
+def test_soft_prompt_changes_logits_and_preserves_shape():
+    cfg, model, params, ids, mask = build()
+    out = model.apply({"params": params}, ids, mask)
+    assert out["logits"].shape == (2, 6, cfg.vocab_size)  # prefix sliced out
+
+    p2 = jax.tree_util.tree_map(lambda x: x, params)
+    # random (non-constant) perturbation — LayerNorm cancels uniform shifts
+    noise = jax.random.normal(jax.random.PRNGKey(7), params["transformer"]["soft_prompt"].shape)
+    p2["transformer"]["soft_prompt"] = params["transformer"]["soft_prompt"] + noise
+    out2 = model.apply({"params": p2}, ids, mask)
+    assert float(jnp.max(jnp.abs(out2["logits"] - out["logits"]))) > 1e-3
+
+
+def test_soft_prompt_generate_cache_consistency():
+    """Cached decode with the soft prefix must match the no-cache forward."""
+    cfg, model, params, ids, mask = build()
+    gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, pad_token_id=0)
+    gen = make_generate_fn(model, gcfg)
+    toks, m = gen({"params": params}, ids, mask, jax.random.PRNGKey(1))
+
+    cur_ids, cur_mask = ids, mask
+    for _ in range(4):
+        out = model.apply({"params": params}, cur_ids, cur_mask)
+        nxt = jnp.argmax(out["logits"][:, -1].astype(jnp.float32), -1)[:, None]
+        cur_ids = jnp.concatenate([cur_ids, nxt], 1)
+        cur_mask = jnp.concatenate([cur_mask, jnp.ones((2, 1), jnp.int32)], 1)
+    np.testing.assert_array_equal(np.array(toks), np.array(cur_ids))
+
+
+def test_softprompt_trainable_mask():
+    import trlx_tpu.trainer.api  # registries
+    from trlx_tpu.trainer import get_model
+
+    cls = get_model("ppo_softprompt")
+    # check mask builder in isolation (no full trainer construction needed)
+    cfg, model, params, ids, mask = build()
+    self_like = type("S", (), {})()
+    tm = cls.build_trainable_mask(self_like, params)
+    assert tm["transformer"]["soft_prompt"] is True
+    assert tm["v_head"]["layers_0"]["kernel"] is True
+    assert tm["transformer"]["h_0"]["attn"]["c_qkv"]["kernel"] is False
+    assert tm["transformer"]["wte"]["embedding"] is False

@@ -55,6 +55,9 @@ class LMWithValueHead(nn.Module):
     branch_layer: int = -1
 
     def setup(self):
+        assert not (self.cfg.n_soft_tokens > 0 and self.branch_layer >= 0), (
+            "soft-prompt models use a full frozen ref copy, not the hydra branch"
+        )
         self.transformer = TransformerLM(self.cfg)
         self.v_head = MLPHead(1, self.cfg)
 
@@ -68,6 +71,7 @@ class LMWithValueHead(nn.Module):
         cache_index=None,
         cache_mask=None,
         collect_branch_hidden: bool = False,
+        prepend_soft: bool = True,
     ):
         out = self.transformer(
             input_ids=input_ids,
@@ -78,6 +82,7 @@ class LMWithValueHead(nn.Module):
             cache_index=cache_index,
             cache_mask=cache_mask,
             collect_hidden_at=self.branch_layer if (collect_branch_hidden and self.branch_layer >= 0) else None,
+            prepend_soft=prepend_soft,
         )
         values = self.v_head(out["hidden"])[..., 0]
         return {
@@ -134,6 +139,7 @@ class LMWithILQLHeads(nn.Module):
         cache=None,
         cache_index=None,
         cache_mask=None,
+        prepend_soft: bool = True,
     ):
         """Returns dict(logits, qs, vs, hidden, cache).
 
@@ -148,6 +154,7 @@ class LMWithILQLHeads(nn.Module):
             cache=cache,
             cache_index=cache_index,
             cache_mask=cache_mask,
+            prepend_soft=prepend_soft,
         )
         hs = out["hidden"]
         if actions_ixs is not None:

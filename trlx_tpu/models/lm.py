@@ -55,6 +55,9 @@ class LMConfig:
     activation: str = "gelu_new"
     ln_eps: float = 1e-5
     embd_pdrop: float = 0.0  # dropout unused in RL fine-tuning; kept for parity
+    # Learned prefix embeddings (soft-prompt tuning; capability counterpart of
+    # the reference's SoftEmbedding, trlx/model/accelerate_ppo_softprompt_model.py:26-81).
+    n_soft_tokens: int = 0
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
@@ -266,6 +269,7 @@ class TransformerLM(nn.Module):
         stop_layer: Optional[int] = None,
         collect_hidden_at: Optional[int] = None,
         compute_logits: bool = True,
+        prepend_soft: bool = True,
     ):
         """Returns dict(logits, hidden, branch_hidden, cache).
 
@@ -290,6 +294,33 @@ class TransformerLM(nn.Module):
         b, q_len = x.shape[:2]
         if attention_mask is None:
             attention_mask = jnp.ones((b, q_len), dtype=jnp.int32)
+
+        # Soft-prompt prefix: prepend learned embeddings ahead of the (left-
+        # padded) sequence; outputs are sliced back so callers see the
+        # original length. `prepend_soft=False` on single-token decode steps
+        # (the prefix already sits in the KV cache from prefill).
+        n_soft = cfg.n_soft_tokens if (cfg.n_soft_tokens > 0 and start_layer == 0) else 0
+        if cfg.n_soft_tokens > 0 and start_layer == 0:
+            soft = self.param(
+                "soft_prompt",
+                nn.initializers.normal(stddev=0.02),
+                (cfg.n_soft_tokens, cfg.d_model),
+                cfg.params_dtype,
+            )
+            if not prepend_soft:
+                n_soft = 0
+        if n_soft:
+            x = jnp.concatenate(
+                [jnp.broadcast_to(soft.astype(cfg.compute_dtype)[None], (b, n_soft, cfg.d_model)), x], axis=1
+            )
+            attention_mask = jnp.concatenate(
+                [jnp.ones((b, n_soft), dtype=attention_mask.dtype), attention_mask], axis=1
+            )
+            if position_ids is not None:
+                position_ids = jnp.concatenate(
+                    [jnp.broadcast_to(jnp.arange(n_soft)[None], (b, n_soft)), position_ids + n_soft], axis=1
+                )
+            q_len = q_len + n_soft
         if position_ids is None:
             if cache is not None and cache_mask is not None:
                 # Decode mode: derive absolute positions from the cache
@@ -336,6 +367,15 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="ln_f")(x)
         if collect_hidden_at is not None and collect_hidden_at == cfg.n_layer:
             branch_hidden = x
+
+        if n_soft:
+            # Drop the soft-prefix positions: callers see the original length.
+            # (Hydra branch replay is incompatible with soft prompts — the
+            # branch would need the prefix context; soft-prompt training uses
+            # a full frozen ref copy instead.)
+            x = x[:, n_soft:]
+            if branch_hidden is not None:
+                branch_hidden = branch_hidden[:, n_soft:]
 
         logits = None
         if compute_logits:

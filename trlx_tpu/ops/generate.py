@@ -51,6 +51,7 @@ def generate(
     cfg = model.cfg
     B, P = prompt_ids.shape
     N = gcfg.max_new_tokens
+    n_soft = cfg.n_soft_tokens
     T = P + N
     eos = gcfg.eos_token_id
 
@@ -59,14 +60,20 @@ def generate(
     )
     mask = jnp.concatenate([prompt_mask.astype(jnp.int32), jnp.zeros((B, N), dtype=jnp.int32)], axis=1)
 
-    cache = init_cache(cfg, B, T)
+    def with_soft(m):
+        """Cache-space mask: soft-prompt slots (always valid) + token slots."""
+        if n_soft == 0:
+            return m
+        return jnp.concatenate([jnp.ones((B, n_soft), dtype=m.dtype), m], axis=1)
+
+    cache = init_cache(cfg, B, T + n_soft)
     out = model.apply(
         variables,
         input_ids=prompt_ids,
         attention_mask=prompt_mask,
         cache=cache,
         cache_index=0,
-        cache_mask=mask,
+        cache_mask=with_soft(mask),
     )
 
     def last_pos(tree):
@@ -119,8 +126,9 @@ def generate(
             input_ids=tok[:, None],
             attention_mask=jnp.ones((B, 1), dtype=jnp.int32),
             cache=s["cache"],
-            cache_index=write_pos,
-            cache_mask=mask,
+            cache_index=write_pos + n_soft,
+            cache_mask=with_soft(mask),
+            prepend_soft=False,
         )
         return {
             "tokens": tokens,

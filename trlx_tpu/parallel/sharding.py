@@ -59,7 +59,7 @@ def lm_partition_rules() -> List[Tuple[str, P]]:
         (r"(v_head|q1_head|q2_head|target_q1_head|target_q2_head)/layers_0/bias$", P(AXIS_TP)),
         (r"(v_head|q1_head|q2_head|target_q1_head|target_q2_head)/layers_1/kernel$", P(AXIS_TP, None)),
         # soft-prompt prefix embeddings [n_tokens, d_model]
-        (r"soft_prompt/embedding$", P(None, AXIS_FSDP)),
+        (r"soft_prompt$", P(None, AXIS_FSDP)),
         # fallback: replicate
         (r".*", P()),
     ]

@@ -9,6 +9,7 @@ from trlx_tpu.data.configs import TRLConfig
 # Importing these modules populates the registries (the reference does the
 # same via package imports, reference: trlx/model/__init__.py:17-36).
 import trlx_tpu.trainer.ppo  # noqa: F401
+import trlx_tpu.trainer.ppo_softprompt  # noqa: F401
 import trlx_tpu.orchestrator.ppo_orchestrator  # noqa: F401
 import trlx_tpu.pipeline.prompt_pipeline  # noqa: F401
 

@@ -47,7 +47,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         super().__init__(config, train_mode=True)
 
         init_distributed()
-        self.mesh = make_mesh(config.train.mesh)
+        self.mesh = make_mesh(config.train.mesh, devices=kwargs.pop("mesh_devices", None))
         set_mesh(self.mesh)
         barrier()  # ≈ reference's init barrier (trlx/model/accelerate_base_model.py:33-34)
 
@@ -57,7 +57,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         # Subclass builds the Flax module + initial host params.
         self.model, init_params = self.get_arch(self.config)
 
-        self.opt_mask = trainable_mask(init_params, self.model.cfg, config.model.num_layers_unfrozen)
+        self.opt_mask = self.build_trainable_mask(init_params)
         self.optimizer = self._build_optimizer()
 
         state = self.init_state(init_params)
@@ -121,7 +121,19 @@ class JaxBaseTrainer(BaseRLTrainer):
                 weight_decay=tc.weight_decay,
             ),
         )
-        return optax.masked(inner, self.opt_mask)
+        # NOTE: optax.masked would pass frozen params' raw gradients through
+        # untouched (it only skips the transform); multi_transform routes them
+        # to set_to_zero, which both freezes them and allocates no Adam
+        # moments for them.
+        labels = jax.tree_util.tree_map(lambda t: "train" if t else "freeze", self.opt_mask)
+        return optax.multi_transform(
+            {"train": inner, "freeze": optax.set_to_zero()}, labels
+        )
+
+    def build_trainable_mask(self, init_params):
+        """Default layer-freezing mask (num_layers_unfrozen); subclasses
+        override for other parameter-efficiency schemes (soft prompts)."""
+        return trainable_mask(init_params, self.model.cfg, self.config.model.num_layers_unfrozen)
 
     def init_state(self, init_params) -> TrainState:
         """Build the initial TrainState (subclasses add extras)."""

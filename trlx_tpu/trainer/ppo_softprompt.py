@@ -1,0 +1,49 @@
+"""Soft-prompt PPO: parameter-efficient prompt tuning under PPO.
+
+Reproduces the daia99 fork's CAPABILITY (learned prefix embeddings, frozen
+LM, generation accounting for the prefix — reference:
+trlx/model/accelerate_ppo_softprompt_model.py:26-173), not its bitrotted
+plumbing (SURVEY.md §2a). Functional design:
+
+- the prefix lives at params/transformer/soft_prompt, prepended inside
+  TransformerLM and sliced back out (callers see original lengths);
+- ONLY the soft prompt + value head receive optimizer updates (optax mask) —
+  the LM trunk is frozen, so Adam moments exist only for the tiny prefix;
+- the KL reference is a full frozen param copy including the INITIAL prefix
+  (the hydra branch cannot replay a prefix it never saw).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.heads import LMWithValueHead
+from trlx_tpu.trainer import register_model
+from trlx_tpu.trainer.ppo import PPOTrainer
+
+
+@register_model("ppo_softprompt")
+@register_model("AcceleratePPOSoftpromptModel")
+class PPOSoftpromptTrainer(PPOTrainer):
+    def get_arch(self, config: TRLConfig):
+        from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
+
+        m = config.method
+        lm_cfg = build_lm_config(config).replace(n_soft_tokens=m.n_soft_tokens)
+        model = LMWithValueHead(lm_cfg, branch_layer=-1)  # full ref copy, no hydra
+        params = load_or_init_params(model, config, self.rng)
+        if m.initialize_from_vocab:
+            # init prefix from the first n vocab embeddings
+            # (reference: trlx/model/accelerate_ppo_softprompt_model.py:55-63)
+            wte = params["transformer"]["wte"]["embedding"]
+            params["transformer"]["soft_prompt"] = jnp.array(wte[: m.n_soft_tokens])
+        return model, params
+
+    def build_trainable_mask(self, init_params):
+        """Train ONLY the soft prompt and the value head."""
+
+        def mask(path, _leaf):
+            keys = [str(getattr(k, "key", k)) for k in path]
+            return "soft_prompt" in keys or "v_head" in keys
+
+        return jax.tree_util.tree_map_with_path(mask, init_params)
