@@ -1,0 +1,44 @@
+"""Architext: PPO-tune a layout model to draw fewer rooms.
+
+Counterpart of the reference (reference: examples/architext.py): the reward
+is simply the negative count of ":" in each generated layout string — a toy
+host-side reward demonstrating arbitrary-Python reward functions over
+decoded text.
+
+Requires network access for: architext/gptj-162M.
+
+Run:  python examples/architext.py
+"""
+
+import trlx_tpu
+
+
+def reward_fn(samples):
+    """Negative room count (rooms are ':'-delimited in architext layouts)."""
+    return [-float(sample.count(":")) for sample in samples]
+
+
+PROMPTS = [
+    "[prompt] the bedroom is adjacent to the living room [layout]",
+    "[prompt] a bedroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is adjacent to the kitchen [layout]",
+    "[prompt] a bedroom is adjacent to the kitchen [layout]",
+    "[prompt] the bedroom is adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is adjacent to the bathroom [layout]",
+    "[prompt] a bathroom is adjacent to the living room [layout]",
+    "[prompt] the bathroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is not adjacent to the living room [layout]",
+    "[prompt] a bedroom is not adjacent to the living room [layout]",
+    "[prompt] the bedroom is not adjacent to the kitchen [layout]",
+    "[prompt] a bedroom is not adjacent to the kitchen [layout]",
+    "[prompt] the bedroom is not adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is not adjacent to the bathroom [layout]",
+]
+
+
+def main():
+    return trlx_tpu.train("architext/gptj-162M", reward_fn=reward_fn, prompts=PROMPTS)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Offline ILQL on sentiment-labeled IMDB: learn positivity from labels alone.
+
+Counterpart of the reference (reference: examples/ilql_sentiments.py): the
+dataset is (review text, 0/1 sentiment label); ILQL learns Q/V heads over the
+frozen-ish LM and decodes with advantage-steered sampling. The sentiment
+classifier is only a METRIC here, not a reward signal.
+
+Requires network access for: gpt2, lvwerra/distilbert-imdb, imdb.
+
+Run:  python examples/ilql_sentiments.py
+"""
+
+import trlx_tpu
+
+
+def build_metric_fn():
+    from transformers import pipeline
+
+    sentiment_fn = pipeline(
+        "sentiment-analysis", "lvwerra/distilbert-imdb", device=-1, top_k=2, truncation=True
+    )
+
+    def metric_fn(samples):
+        outputs = sentiment_fn(samples)
+        return {
+            "sentiments": [
+                next(d["score"] for d in out if d["label"] == "POSITIVE") for out in outputs
+            ]
+        }
+
+    return metric_fn
+
+
+def main():
+    from datasets import load_dataset
+
+    imdb = load_dataset("imdb", split="train+test")
+
+    return trlx_tpu.train(
+        "gpt2",
+        dataset=(imdb["text"], imdb["label"]),
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        metric_fn=build_metric_fn(),
+    )
+
+
+if __name__ == "__main__":
+    main()

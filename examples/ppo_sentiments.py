@@ -1,0 +1,51 @@
+"""PPO sentiment tuning: make gpt2-imdb write positive movie reviews.
+
+Counterpart of the reference's flagship example
+(reference: examples/ppo_sentiments.py): a distilbert-imdb sentiment
+classifier is the reward function; prompts are the first few words of IMDB
+reviews. The reward model runs on HOST (torch-cpu) while rollouts and PPO
+updates run as compiled XLA programs on the TPU mesh — the host/device
+overlap the orchestrator manages (SURVEY.md §7 hard part 2).
+
+Requires network access for the HF checkpoints/datasets:
+    lvwerra/gpt2-imdb, lvwerra/distilbert-imdb, imdb
+
+Run:  python examples/ppo_sentiments.py
+"""
+
+import trlx_tpu
+
+
+def build_reward_fn():
+    from transformers import pipeline
+
+    sentiment_fn = pipeline(
+        "sentiment-analysis", "lvwerra/distilbert-imdb", device=-1, top_k=2, truncation=True
+    )
+
+    def reward_fn(samples):
+        # score of the POSITIVE class, order-stable regardless of ranking
+        outputs = sentiment_fn(samples)
+        return [
+            next(d["score"] for d in out if d["label"] == "POSITIVE") for out in outputs
+        ]
+
+    return reward_fn
+
+
+def main():
+    from datasets import load_dataset
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        "lvwerra/gpt2-imdb",
+        reward_fn=build_reward_fn(),
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+    )
+
+
+if __name__ == "__main__":
+    main()

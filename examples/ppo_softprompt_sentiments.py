@@ -1,0 +1,40 @@
+"""Soft-prompt PPO sentiments: tune ONLY a learned prefix, LM frozen.
+
+Counterpart of the daia99 fork's addition
+(reference: examples/ppo_softprompt_sentiments.py +
+trlx/model/accelerate_ppo_softprompt_model.py). The fork's example is
+bitrotted against its own refactored base (SURVEY.md §2a); this one
+reproduces the CAPABILITY — parameter-efficient prompt tuning under PPO —
+through the working `train()` path: optimizer updates are optax-masked to
+the soft prefix + value head only, so Adam state exists for a few thousand
+parameters instead of the whole LM.
+
+Requires network access for: lvwerra/gpt2-imdb, lvwerra/distilbert-imdb, imdb.
+
+Run:  python examples/ppo_softprompt_sentiments.py
+"""
+
+import trlx_tpu
+from trlx_tpu.trainer.api import default_config
+
+from ppo_sentiments import build_reward_fn
+
+
+def main():
+    from datasets import load_dataset
+
+    config = default_config("ppo_softprompt")
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+
+    return trlx_tpu.train(
+        reward_fn=build_reward_fn(),
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    main()

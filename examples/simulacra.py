@@ -1,0 +1,51 @@
+"""Simulacra: offline ILQL on image-prompt/aesthetic-rating pairs.
+
+Counterpart of the reference (reference: examples/simulacra.py): trains gpt2
+to produce higher-rated image prompts from the Simulacra Aesthetic Captions
+SQLite database (https://github.com/JD-P/simulacra-aesthetic-captions).
+
+Requires network access for: gpt2 and the sqlite dataset download.
+
+Run:  python examples/simulacra.py
+"""
+
+import os
+import sqlite3
+from urllib.request import urlretrieve
+
+import trlx_tpu
+
+URL = (
+    "https://raw.githubusercontent.com/JD-P/simulacra-aesthetic-captions/main/"
+    "sac_public_2022_06_29.sqlite"
+)
+DBPATH = "sac_public_2022_06_29.sqlite"
+
+
+def load_ratings(dbpath: str = DBPATH):
+    if not os.path.exists(dbpath):
+        print(f"fetching {dbpath}")
+        urlretrieve(URL, dbpath)
+    conn = sqlite3.connect(dbpath)
+    rows = conn.execute(
+        "SELECT prompt, rating FROM ratings "
+        "JOIN images ON images.id=ratings.iid "
+        "JOIN generations ON images.gid=generations.id "
+        "WHERE rating IS NOT NULL;"
+    ).fetchall()
+    conn.close()
+    prompts, ratings = map(list, zip(*rows))
+    return prompts, ratings
+
+
+def main():
+    prompts, ratings = load_ratings()
+    return trlx_tpu.train(
+        "gpt2",
+        dataset=(prompts, ratings),
+        eval_prompts=["Hatsune Miku, Red Dress"] * 64,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -7,11 +7,18 @@ semantics are tested single-host via --xla_force_host_platform_device_count.
 import os
 
 # Force CPU: the container's default JAX_PLATFORMS=axon points at a single
-# tunneled TPU that test processes must not contend for.
+# tunneled TPU that test processes must not contend for. The axon
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter startup, which overrides the env var — so the env var alone is
+# not enough; jax.config.update below wins because it runs later.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 
