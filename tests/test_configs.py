@@ -50,3 +50,13 @@ def test_orchestrator_registry():
 
     assert get_orchestrator("PPOOrchestrator") is not None
     assert get_orchestrator("OfflineOrchestrator") is not None
+
+
+def test_all_shipped_configs_load():
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(CONFIG_DIR, "*.yml")))
+    assert len(paths) >= 5
+    for path in paths:
+        cfg = TRLConfig.load_yaml(path)
+        assert cfg.train.batch_size > 0, path

@@ -102,3 +102,35 @@ def test_min_new_tokens_suppresses_eos():
     out_late = process_logits_default(logits, gcfg, jnp.array(3))
     assert out_early[0, 2] <= NEG_INF / 2
     assert out_late[0, 2] == 0.0
+
+
+def test_local_attention_decode_matches_teacher_forcing():
+    """gpt-neo-style alternating global/local layers: the KV-cache decode path
+    must apply the same windowed mask as the full-sequence forward."""
+    cfg = LMConfig(
+        vocab_size=23,
+        n_layer=2,
+        n_head=2,
+        d_model=32,
+        max_position=64,
+        dtype="float32",
+        scale_attn=False,
+        attention_layers=("global", "local"),
+        window_size=4,
+    )
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 6), 2, cfg.vocab_size)
+    mask = jnp.ones((2, 6), jnp.int32)
+    params = {"params": model.init(rng, ids, mask)["params"]}
+
+    gcfg = GenerateConfig(max_new_tokens=6, do_sample=False, eos_token_id=None, pad_token_id=0)
+    toks, _ = make_generate_fn(model, gcfg)(params, ids, mask, jax.random.PRNGKey(1))
+
+    cur_ids, cur_mask = ids, mask
+    for _ in range(6):
+        out = model.apply(params, cur_ids, cur_mask)
+        nxt = jnp.argmax(out["logits"][:, -1].astype(jnp.float32), -1)[:, None]
+        cur_ids = jnp.concatenate([cur_ids, nxt], 1)
+        cur_mask = jnp.concatenate([cur_mask, jnp.ones((2, 1), jnp.int32)], 1)
+    np.testing.assert_array_equal(np.array(cur_ids[:, 6:]), np.array(toks[:, 6:]))

@@ -20,14 +20,14 @@ from trlx_tpu.models.hf_import import (
 )
 
 
-def compare(hf_model, converter, atol=2e-4):
+def compare(hf_model, converter, atol=2e-4, seq_len=12):
     hf_model.eval()
     cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
     trunk = converter(sd, cfg)
 
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(2, 12))
+    ids = rng.integers(0, cfg.vocab_size, size=(2, seq_len))
     with torch.no_grad():
         ref = hf_model(torch.as_tensor(ids)).logits.numpy()
 
@@ -60,3 +60,21 @@ def test_neox_parity():
         rotary_pct=0.25,
     )
     compare(transformers.GPTNeoXForCausalLM(config), convert_neox)
+
+
+def test_gpt_neo_parity():
+    """Alternating global/local layers with a window SHORTER than the
+    sequence, so the windowed mask actually changes the logits; unscaled
+    attention is gpt-neo's other quirk."""
+    from trlx_tpu.models.hf_import import convert_gpt_neo
+
+    config = transformers.GPTNeoConfig(
+        num_layers=2,
+        num_heads=4,
+        hidden_size=64,
+        vocab_size=128,
+        max_position_embeddings=64,
+        attention_types=[[["global", "local"], 1]],
+        window_size=8,
+    )
+    compare(transformers.GPTNeoForCausalLM(config), convert_gpt_neo, seq_len=24)

@@ -70,6 +70,26 @@ def lm_config_from_hf(hf, **overrides) -> LMConfig:
             ln_eps=hf.layer_norm_epsilon,
             extra={"lm_head_bias": True},
         )
+    elif t == "gpt_neo":
+        d = dict(
+            vocab_size=hf.vocab_size,
+            n_layer=hf.num_layers,
+            n_head=hf.num_heads,
+            d_model=hf.hidden_size,
+            d_ff=hf.intermediate_size or 0,
+            max_position=hf.max_position_embeddings,
+            pos_type="learned",
+            parallel_residual=False,
+            fused_qkv=False,
+            qkv_bias=False,
+            out_bias=True,
+            scale_attn=False,  # gpt-neo attention is unscaled
+            attention_layers=tuple(hf.attention_layers),
+            window_size=hf.window_size,
+            tie_word_embeddings=True,
+            activation=hf.activation_function,
+            ln_eps=hf.layer_norm_epsilon,
+        )
     elif t == "gpt_neox":
         head_dim = hf.hidden_size // hf.num_attention_heads
         d = dict(
@@ -123,6 +143,8 @@ def load_hf_trunk(model_path: str, cfg: LMConfig) -> Dict[str, Any]:
         return convert_gpt2(sd, cfg)
     if t == "gptj":
         return convert_gptj(sd, cfg)
+    if t == "gpt_neo":
+        return convert_gpt_neo(sd, cfg)
     if t == "gpt_neox":
         return convert_neox(sd, cfg)
     raise ValueError(f"cannot detect supported family from state dict ({list(sd)[:3]}...)")
@@ -131,6 +153,8 @@ def load_hf_trunk(model_path: str, cfg: LMConfig) -> Dict[str, Any]:
 def _detect_family(sd) -> str:
     if any(k.startswith("transformer.h.") and ".attn.c_attn." in k for k in sd):
         return "gpt2"
+    if any(".attn.attention.q_proj." in k for k in sd):
+        return "gpt_neo"
     if any(".attn.q_proj." in k for k in sd):
         return "gptj"
     if any("gpt_neox.layers." in k for k in sd):
@@ -186,6 +210,34 @@ def convert_gptj(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
             "mlp": {
                 "c_fc": {"kernel": sd[f"{h}.mlp.fc_in.weight"].T, "bias": sd[f"{h}.mlp.fc_in.bias"]},
                 "c_proj": {"kernel": sd[f"{h}.mlp.fc_out.weight"].T, "bias": sd[f"{h}.mlp.fc_out.bias"]},
+            },
+        }
+    return p
+
+
+def convert_gpt_neo(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    """GPT-Neo: gpt2-style trunk but nn.Linear projections ([out, in] →
+    transpose), biasless q/k/v, tied head."""
+    p: Dict[str, Any] = {
+        "wte": {"embedding": sd["transformer.wte.weight"]},
+        "wpe": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+    }
+    for i in range(cfg.n_layer):
+        h = f"transformer.h.{i}"
+        a = f"{h}.attn.attention"
+        p[f"h_{i}"] = {
+            "ln_1": _ln(sd, f"{h}.ln_1"),
+            "ln_2": _ln(sd, f"{h}.ln_2"),
+            "attn": {
+                "q_proj": {"kernel": sd[f"{a}.q_proj.weight"].T},
+                "k_proj": {"kernel": sd[f"{a}.k_proj.weight"].T},
+                "v_proj": {"kernel": sd[f"{a}.v_proj.weight"].T},
+                "c_proj": {"kernel": sd[f"{a}.out_proj.weight"].T, "bias": sd[f"{a}.out_proj.bias"]},
+            },
+            "mlp": {
+                "c_fc": {"kernel": sd[f"{h}.mlp.c_fc.weight"].T, "bias": sd[f"{h}.mlp.c_fc.bias"]},
+                "c_proj": {"kernel": sd[f"{h}.mlp.c_proj.weight"].T, "bias": sd[f"{h}.mlp.c_proj.bias"]},
             },
         }
     return p

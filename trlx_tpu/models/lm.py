@@ -51,6 +51,12 @@ class LMConfig:
     fused_qkv: bool = True
     qkv_bias: bool = True
     out_bias: bool = True
+    scale_attn: bool = True  # gpt-neo quirk: no 1/sqrt(head_dim) scaling
+    # Per-layer attention pattern ("global" | "local"); empty → all global.
+    # Local layers attend within a trailing window (gpt-neo's alternating
+    # global/local stack).
+    attention_layers: Tuple[str, ...] = ()
+    window_size: int = 0
     tie_word_embeddings: bool = True
     activation: str = "gelu_new"
     ln_eps: float = 1e-5
@@ -85,6 +91,8 @@ class LMConfig:
     @classmethod
     def from_dict(cls, d: Dict[str, Any]):
         known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        if "attention_layers" in known:
+            known["attention_layers"] = tuple(known["attention_layers"])
         return cls(**known)
 
 
@@ -180,7 +188,8 @@ class Attention(nn.Module):
 
         # [b, n_head, q, kv] scores in fp32 for a stable softmax.
         scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-        scores = scores / np.sqrt(hd)
+        if cfg.scale_attn:
+            scores = scores / np.sqrt(hd)
         scores = scores + attn_bias  # additive -inf mask [b, 1, q, kv]
         probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(dtype))
@@ -228,19 +237,23 @@ class Block(nn.Module):
         return x, new_cache
 
 
-def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset) -> jnp.ndarray:
+def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset, window: int = 0) -> jnp.ndarray:
     """Build the additive attention bias [b, 1, q_len, kv_len].
 
     attn_mask_kv: [b, kv_len] validity of each key slot (handles left padding
     — the reference instead relies on HF mask plumbing plus position-id
     correction, reference: trlx/model/accelerate_ppo_model.py:110-112).
-    Causality is by buffer index: key j visible to query i iff j <= q_offset+i.
+    Causality is by buffer index: key j visible to query i iff j <= q_offset+i;
+    `window > 0` additionally requires j > q_offset+i−window (gpt-neo local
+    attention layers).
     """
     kv_len = attn_mask_kv.shape[-1]
     q_idx = q_offset + jnp.arange(q_len)[:, None]
     k_idx = jnp.arange(kv_len)[None, :]
-    causal = (k_idx <= q_idx)[None, None, :, :]
-    valid = attn_mask_kv[:, None, None, :].astype(bool) & causal
+    causal = k_idx <= q_idx
+    if window > 0:
+        causal = causal & (k_idx > q_idx - window)
+    valid = attn_mask_kv[:, None, None, :].astype(bool) & causal[None, None, :, :]
     return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
 
 
@@ -341,9 +354,13 @@ class TransformerLM(nn.Module):
 
         if cache is not None:
             kv_mask = cache_mask if cache_mask is not None else attention_mask
-            attn_bias = make_attn_bias(kv_mask, q_len, cache_index)
+            bias_mask, bias_offset = kv_mask, cache_index
         else:
-            attn_bias = make_attn_bias(attention_mask, q_len, 0)
+            bias_mask, bias_offset = attention_mask, 0
+        attn_bias = make_attn_bias(bias_mask, q_len, bias_offset)
+        local_bias = None
+        if any(t == "local" for t in cfg.attention_layers):
+            local_bias = make_attn_bias(bias_mask, q_len, bias_offset, window=cfg.window_size)
 
         block_cls = Block
         if cfg.remat:
@@ -360,7 +377,9 @@ class TransformerLM(nn.Module):
             if collect_hidden_at is not None and i == collect_hidden_at:
                 branch_hidden = x
             layer_cache = cache[i] if cache is not None else None
-            x, layer_new_cache = block(x, attn_bias, position_ids, layer_cache, cache_index)
+            is_local = bool(cfg.attention_layers) and cfg.attention_layers[i] == "local"
+            layer_bias = local_bias if is_local else attn_bias
+            x, layer_new_cache = block(x, layer_bias, position_ids, layer_cache, cache_index)
             if cache is not None:
                 new_cache.append(layer_new_cache)
 
