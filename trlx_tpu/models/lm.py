@@ -64,6 +64,10 @@ class LMConfig:
     # Learned prefix embeddings (soft-prompt tuning; capability counterpart of
     # the reference's SoftEmbedding, trlx/model/accelerate_ppo_softprompt_model.py:26-81).
     n_soft_tokens: int = 0
+    # Attention kernel: "auto" routes long aligned sequences through the
+    # pallas flash kernel (trlx_tpu/ops/flash_attention.py) and everything
+    # else through XLA einsum; "flash"/"xla" force a path.
+    attn_impl: str = "auto"
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
@@ -137,19 +141,48 @@ def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray, rotary_dim:
 # ---------------------------------------------------------------------------
 
 
+def _flash_block(q_len: int) -> int:
+    # 512x512 blocks: best measured on v5e (7.7ms vs einsum 10.7ms at
+    # b=4,T=2048,h=16,d=64); clamped so short sequences still divide evenly.
+    return min(512, q_len)
+
+
+def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool) -> bool:
+    """Static routing decision between the pallas flash kernel and XLA einsum.
+
+    Flash only applies to full-sequence (no-KV-cache) passes; decode steps
+    have q_len==1 and stay on einsum. "auto" reserves flash for long aligned
+    sequences where the O(T^2) bias materialization actually hurts.
+    """
+    if cfg.attn_impl not in ("auto", "flash", "xla"):
+        raise ValueError(f"attn_impl must be auto|flash|xla, got {cfg.attn_impl!r}")
+    from trlx_tpu.ops.flash_attention import _HAVE_PLTPU
+
+    if has_cache or cfg.attn_impl == "xla" or not _HAVE_PLTPU:
+        return False
+    if q_len % _flash_block(q_len):
+        return False
+    if cfg.attn_impl == "auto":
+        return q_len >= 256 and q_len % 128 == 0
+    return True
+
+
 class Attention(nn.Module):
     """Multi-head causal attention with functional KV cache.
 
     Layout: qkv projections are column-parallel over tp (see
     trlx_tpu/parallel/sharding.py), output projection row-parallel. Softmax in
     fp32. The cache is `(k, v)` of shape [b, cache_len, n_head, head_dim]
-    written at `cache_index` with dynamic_update_slice.
+    written at `cache_index` with dynamic_update_slice. When `flash_mask` is
+    given (and attn_bias is None) the score/softmax/value contraction runs in
+    the fused pallas kernel instead of einsum.
     """
 
     cfg: LMConfig
 
     @nn.compact
-    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None):
+    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
+                 flash_mask=None, window=0):
         cfg = self.cfg
         dtype = cfg.compute_dtype
         b, q_len, _ = x.shape
@@ -186,13 +219,22 @@ class Attention(nn.Module):
             k, v = k_cache, v_cache
             new_cache = (k_cache, v_cache)
 
-        # [b, n_head, q, kv] scores in fp32 for a stable softmax.
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-        if cfg.scale_attn:
-            scores = scores / np.sqrt(hd)
-        scores = scores + attn_bias  # additive -inf mask [b, 1, q, kv]
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(dtype))
+        scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
+        if flash_mask is not None:
+            from trlx_tpu.ops.flash_attention import flash_attention
+
+            blk = _flash_block(q_len)
+            out = flash_attention(
+                q, k, v, flash_mask, scale=scale, causal=True, window=window,
+                block_q=blk, block_k=blk,
+            ).astype(dtype)
+        else:
+            # [b, n_head, q, kv] scores in fp32 for a stable softmax.
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+            scores = scores * scale
+            scores = scores + attn_bias  # additive -inf mask [b, 1, q, kv]
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(dtype))
         out = out.reshape(b, q_len, cfg.d_model)
         out = dense(cfg.d_model, "c_proj", cfg.out_bias)(out)
         return out, new_cache
@@ -222,16 +264,18 @@ class Block(nn.Module):
     cfg: LMConfig
 
     @nn.compact
-    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None):
+    def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
+                 flash_mask=None, window=0):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name=name)
+        attn = Attention(cfg, name="attn")
         if cfg.parallel_residual:
             h = ln("ln_1")(x)
-            attn_out, new_cache = Attention(cfg, name="attn")(h, attn_bias, positions, cache, cache_index)
+            attn_out, new_cache = attn(h, attn_bias, positions, cache, cache_index, flash_mask, window)
             mlp_in = ln("ln_2")(x) if cfg.use_parallel_ln else h
             x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
         else:
-            attn_out, new_cache = Attention(cfg, name="attn")(ln("ln_1")(x), attn_bias, positions, cache, cache_index)
+            attn_out, new_cache = attn(ln("ln_1")(x), attn_bias, positions, cache, cache_index, flash_mask, window)
             x = x + attn_out
             x = x + MLP(cfg, name="mlp")(ln("ln_2")(x))
         return x, new_cache
@@ -352,15 +396,21 @@ class TransformerLM(nn.Module):
             )(position_ids)
             x = x + wpe
 
-        if cache is not None:
-            kv_mask = cache_mask if cache_mask is not None else attention_mask
-            bias_mask, bias_offset = kv_mask, cache_index
+        use_flash = flash_eligible(cfg, q_len, cache is not None)
+        if use_flash:
+            attn_bias = local_bias = None
+            flash_mask = attention_mask.astype(jnp.float32)
         else:
-            bias_mask, bias_offset = attention_mask, 0
-        attn_bias = make_attn_bias(bias_mask, q_len, bias_offset)
-        local_bias = None
-        if any(t == "local" for t in cfg.attention_layers):
-            local_bias = make_attn_bias(bias_mask, q_len, bias_offset, window=cfg.window_size)
+            flash_mask = None
+            if cache is not None:
+                kv_mask = cache_mask if cache_mask is not None else attention_mask
+                bias_mask, bias_offset = kv_mask, cache_index
+            else:
+                bias_mask, bias_offset = attention_mask, 0
+            attn_bias = make_attn_bias(bias_mask, q_len, bias_offset)
+            local_bias = None
+            if any(t == "local" for t in cfg.attention_layers):
+                local_bias = make_attn_bias(bias_mask, q_len, bias_offset, window=cfg.window_size)
 
         block_cls = Block
         if cfg.remat:
@@ -379,7 +429,11 @@ class TransformerLM(nn.Module):
             layer_cache = cache[i] if cache is not None else None
             is_local = bool(cfg.attention_layers) and cfg.attention_layers[i] == "local"
             layer_bias = local_bias if is_local else attn_bias
-            x, layer_new_cache = block(x, layer_bias, position_ids, layer_cache, cache_index)
+            layer_window = cfg.window_size if is_local else 0
+            x, layer_new_cache = block(
+                x, layer_bias, position_ids, layer_cache, cache_index,
+                flash_mask, layer_window,
+            )
             if cache is not None:
                 new_cache.append(layer_new_cache)
 
