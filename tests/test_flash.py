@@ -96,14 +96,30 @@ def test_model_flash_matches_xla_path():
     np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_x), atol=5e-4)
 
 
-def test_auto_routing_thresholds():
+def test_auto_routing_thresholds(monkeypatch):
+    from trlx_tpu.models import lm as lm_mod
     from trlx_tpu.models.lm import flash_eligible
 
+    # Off-TPU (these tests), auto NEVER picks the (interpret-mode) kernel —
+    # the einsum path is far faster there.
     auto = LMConfig(attn_impl="auto")
+    assert not flash_eligible(auto, 512, has_cache=False)
+
+    # On TPU, auto takes long aligned full-sequence passes only.
+    monkeypatch.setattr(lm_mod.jax, "default_backend", lambda: "tpu")
     assert not flash_eligible(auto, 64, has_cache=False)  # short RLHF seqs
     assert flash_eligible(auto, 512, has_cache=False)
+    assert flash_eligible(auto, 768, has_cache=False)  # 128-aligned, non-512
     assert not flash_eligible(auto, 512, has_cache=True)  # decode
     assert not flash_eligible(auto, 300, has_cache=False)  # unaligned
     forced = LMConfig(attn_impl="flash")
     assert flash_eligible(forced, 48, has_cache=False)
     assert not flash_eligible(LMConfig(attn_impl="xla"), 512, has_cache=False)
+    with pytest.raises(ValueError):
+        flash_eligible(LMConfig(attn_impl="pallas"), 512, has_cache=False)
+
+    from trlx_tpu.models.lm import _flash_block
+
+    assert _flash_block(2048) == 512
+    assert _flash_block(768) == 256
+    assert _flash_block(48) == 48

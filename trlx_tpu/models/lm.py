@@ -68,6 +68,10 @@ class LMConfig:
     # pallas flash kernel (trlx_tpu/ops/flash_attention.py) and everything
     # else through XLA einsum; "flash"/"xla" force a path.
     attn_impl: str = "auto"
+    # Sequence/context parallelism: >1 routes full-sequence attention through
+    # the sp-axis ring (trlx_tpu/parallel/ring_attention.py). Set by the
+    # trainer from the mesh; 0/1 disables.
+    sp_size: int = 0
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
@@ -142,9 +146,29 @@ def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray, rotary_dim:
 
 
 def _flash_block(q_len: int) -> int:
-    # 512x512 blocks: best measured on v5e (7.7ms vs einsum 10.7ms at
-    # b=4,T=2048,h=16,d=64); clamped so short sequences still divide evenly.
-    return min(512, q_len)
+    # 512x512 blocks measured best on v5e (7.7ms vs einsum 10.7ms at
+    # b=4,T=2048,h=16,d=64); fall to the largest 128-multiple that divides
+    # q_len (768 → 256), else a single whole-length block.
+    for blk in (512, 256, 128):
+        if q_len % blk == 0:
+            return blk
+    return q_len
+
+
+def ring_eligible(cfg: LMConfig, q_len: int, has_cache: bool, batch: Optional[int] = None) -> bool:
+    """Sequence-parallel ring attention applies to full-sequence passes when
+    the model was built for an sp>1 mesh and the (static) shapes divide the
+    mesh: seq over sp, batch over (dp, fsdp), heads over tp. Decode steps
+    (q_len==1, KV cache) and tiny init/tracing shapes stay local."""
+    if cfg.sp_size <= 1 or has_cache or q_len % cfg.sp_size:
+        return False
+    from trlx_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_TP, get_mesh
+
+    mesh = get_mesh()
+    data = int(mesh.shape[AXIS_DP]) * int(mesh.shape[AXIS_FSDP])
+    if batch is not None and batch % data:
+        return False
+    return cfg.n_head % int(mesh.shape[AXIS_TP]) == 0
 
 
 def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool) -> bool:
@@ -160,9 +184,11 @@ def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool) -> bool:
 
     if has_cache or cfg.attn_impl == "xla" or not _HAVE_PLTPU:
         return False
-    if q_len % _flash_block(q_len):
-        return False
     if cfg.attn_impl == "auto":
+        # auto never picks interpret-mode pallas: off-TPU the einsum path is
+        # far faster. Tests reach the kernels via attn_impl="flash".
+        if jax.default_backend() != "tpu":
+            return False
         return q_len >= 256 and q_len % 128 == 0
     return True
 
@@ -182,7 +208,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
-                 flash_mask=None, window=0):
+                 flash_mask=None, window=0, use_ring=False):
         cfg = self.cfg
         dtype = cfg.compute_dtype
         b, q_len, _ = x.shape
@@ -221,13 +247,20 @@ class Attention(nn.Module):
 
         scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
         if flash_mask is not None:
-            from trlx_tpu.ops.flash_attention import flash_attention
+            if use_ring:
+                from trlx_tpu.parallel.ring_attention import ring_attention_sharded
 
-            blk = _flash_block(q_len)
-            out = flash_attention(
-                q, k, v, flash_mask, scale=scale, causal=True, window=window,
-                block_q=blk, block_k=blk,
-            ).astype(dtype)
+                out = ring_attention_sharded(
+                    q, k, v, flash_mask, scale=scale, causal=True, window=window
+                ).astype(dtype)
+            else:
+                from trlx_tpu.ops.flash_attention import flash_attention
+
+                blk = _flash_block(q_len)
+                out = flash_attention(
+                    q, k, v, flash_mask, scale=scale, causal=True, window=window,
+                    block_q=blk, block_k=blk,
+                ).astype(dtype)
         else:
             # [b, n_head, q, kv] scores in fp32 for a stable softmax.
             scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
@@ -265,17 +298,17 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
-                 flash_mask=None, window=0):
+                 flash_mask=None, window=0, use_ring=False):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name=name)
         attn = Attention(cfg, name="attn")
         if cfg.parallel_residual:
             h = ln("ln_1")(x)
-            attn_out, new_cache = attn(h, attn_bias, positions, cache, cache_index, flash_mask, window)
+            attn_out, new_cache = attn(h, attn_bias, positions, cache, cache_index, flash_mask, window, use_ring)
             mlp_in = ln("ln_2")(x) if cfg.use_parallel_ln else h
             x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
         else:
-            attn_out, new_cache = attn(ln("ln_1")(x), attn_bias, positions, cache, cache_index, flash_mask, window)
+            attn_out, new_cache = attn(ln("ln_1")(x), attn_bias, positions, cache, cache_index, flash_mask, window, use_ring)
             x = x + attn_out
             x = x + MLP(cfg, name="mlp")(ln("ln_2")(x))
         return x, new_cache
@@ -396,7 +429,8 @@ class TransformerLM(nn.Module):
             )(position_ids)
             x = x + wpe
 
-        use_flash = flash_eligible(cfg, q_len, cache is not None)
+        use_ring = ring_eligible(cfg, q_len, cache is not None, b)
+        use_flash = use_ring or flash_eligible(cfg, q_len, cache is not None)
         if use_flash:
             attn_bias = local_bias = None
             flash_mask = attention_mask.astype(jnp.float32)
@@ -432,7 +466,7 @@ class TransformerLM(nn.Module):
             layer_window = cfg.window_size if is_local else 0
             x, layer_new_cache = block(
                 x, layer_bias, position_ids, layer_cache, cache_index,
-                flash_mask, layer_window,
+                flash_mask, layer_window, use_ring,
             )
             if cache is not None:
                 new_cache.append(layer_new_cache)

@@ -1,0 +1,125 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+The reference has NO long-context machinery (SURVEY.md §2c/§5 — max seq 64);
+this is a TPU-first capability extension that the mesh design reserved the
+`sp` axis for. Each device holds a [b, T/n, h, d] sequence chunk; K/V chunks
+rotate around the ring via `lax.ppermute` over ICI while every device
+accumulates attention of its local queries against each visiting chunk with
+an online softmax (the same math as the pallas flash kernel, at chunk
+granularity). Peak memory per device is O(T/n) in sequence — the [T, T]
+score matrix never exists, and neither does a gathered K/V.
+
+Differentiable by construction: `ppermute` and `scan` have exact transposes,
+so `jax.grad` through a shard_map'd ring pass yields the reverse ring — no
+hand-written backward needed.
+
+Causality uses GLOBAL positions (chunk offset × chunk len + local index), so
+results match single-device attention bit-for-bit up to reduction order.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+try:  # jax >= 0.7 stabilized name
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=check_rep)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_rep=check_rep)
+
+from trlx_tpu.parallel.mesh import AXIS_SP, AXIS_TP, DATA_AXES, get_mesh
+
+MASK_VAL = -1e9
+M_INIT = -1e30
+
+
+def ring_attention(q, k, v, kv_mask, *, axis_name: str, n_ring: int, scale: float,
+                   causal: bool = True, window: int = 0):
+    """Per-device body (call inside shard_map over `axis_name`).
+
+    q/k/v: [b, t_local, h, d] — this device's sequence chunk, rotary already
+    applied. kv_mask: [b, t_local] key validity (left padding). Returns
+    [b, t_local, h, d] attention outputs for the local queries.
+    """
+    b, t, h, d = q.shape
+    idx = jax.lax.axis_index(axis_name)
+    q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((b, h, t, 1), M_INIT, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
+
+    def attend(k_c, v_c, mask_c, i, m, l, acc):
+        src = (idx - i) % n_ring  # which chunk is visiting this step
+        k_pos = src * t + jnp.arange(t)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32)) * scale
+        pair = mask_c[:, None, None, :] > 0
+        kp = k_pos[None, None, None, :]
+        qp = q_pos[None, None, :, None]
+        if causal:
+            pair = pair & (kp <= qp)
+        if window > 0:
+            pair = pair & (kp > qp - window)
+        s = jnp.where(pair, s, MASK_VAL)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        k_c, v_c, mask_c, m, l, acc = carry
+        m, l, acc = attend(k_c, v_c, mask_c, i, m, l, acc)
+        k_nxt = jax.lax.ppermute(k_c, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_c, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_c, axis_name, perm)
+        return (k_nxt, v_nxt, mask_nxt, m, l, acc), None
+
+    # The last visiting chunk is attended OUTSIDE the scan so its rotation
+    # (whose result would be discarded) is never issued.
+    carry = (k, v, kv_mask, m0, l0, acc0)
+    if n_ring > 1:
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(n_ring - 1))
+    k_c, v_c, mask_c, m, l, acc = carry
+    _, l, acc = attend(k_c, v_c, mask_c, jnp.asarray(n_ring - 1), m, l, acc)
+    out = acc / l  # fully-masked pad rows degrade to a uniform mix, like the
+    # einsum/flash paths; every loss masks them.
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, kv_mask, *, scale: float, causal: bool = True,
+                           window: int = 0, mesh=None):
+    """jit-composable entry: shard_map over the full (dp, fsdp, tp, sp) mesh.
+
+    q/k/v: GLOBAL [b, T, h, d] logical arrays (XLA reshards at the shard_map
+    boundary): batch over (dp, fsdp), sequence over sp, heads over tp.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh if mesh is not None else get_mesh()
+    n_ring = mesh.shape[AXIS_SP]
+    qkv_spec = P(DATA_AXES, AXIS_SP, AXIS_TP, None)
+    mask_spec = P(DATA_AXES, AXIS_SP)
+    body = partial(
+        ring_attention, axis_name=AXIS_SP, n_ring=n_ring, scale=scale,
+        causal=causal, window=window,
+    )
+    return shard_map(
+        lambda q, k, v, m: body(q, k, v, m),
+        mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )(q, k, v, kv_mask)

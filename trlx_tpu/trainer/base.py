@@ -196,6 +196,16 @@ class JaxBaseTrainer(BaseRLTrainer):
 
         return jax.tree_util.tree_map(put, tree)
 
+    def finalize_lm_config(self, lm_cfg):
+        """Inject mesh-derived settings the architecture needs statically:
+        sp>1 turns on ring-attention sequence parallelism."""
+        from trlx_tpu.parallel.mesh import AXIS_SP
+
+        sp = int(self.mesh.shape[AXIS_SP])
+        if sp > 1:
+            lm_cfg = lm_cfg.replace(sp_size=sp)
+        return lm_cfg
+
     # ------------------------------------------------------------- abstracts
 
     @abstractmethod

@@ -80,7 +80,7 @@ class ILQLTrainer(JaxBaseTrainer):
     def get_arch(self, config: TRLConfig):
         from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
 
-        lm_cfg = build_lm_config(config)
+        lm_cfg = self.finalize_lm_config(build_lm_config(config))
         model = LMWithILQLHeads(lm_cfg, two_qs=config.method.two_qs)
         params = load_or_init_params(model, config, self.rng)
         return model, params

@@ -119,7 +119,7 @@ class PPOTrainer(JaxBaseTrainer):
         GPTHydraHeadWithValueModel (reference: trlx/model/nn/ppo_models.py:315-346)."""
         from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
 
-        lm_cfg = build_lm_config(config)
+        lm_cfg = self.finalize_lm_config(build_lm_config(config))
         k = config.model.num_layers_unfrozen
         branch_layer = lm_cfg.n_layer - k if k > 0 else -1
         model = LMWithValueHead(lm_cfg, branch_layer=branch_layer)

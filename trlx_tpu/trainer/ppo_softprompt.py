@@ -29,7 +29,7 @@ class PPOSoftpromptTrainer(PPOTrainer):
         from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
 
         m = config.method
-        lm_cfg = build_lm_config(config).replace(n_soft_tokens=m.n_soft_tokens)
+        lm_cfg = self.finalize_lm_config(build_lm_config(config).replace(n_soft_tokens=m.n_soft_tokens))
         model = LMWithValueHead(lm_cfg, branch_layer=-1)  # full ref copy, no hydra
         params = load_or_init_params(model, config, self.rng)
         if m.initialize_from_vocab:
