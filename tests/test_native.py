@@ -1,0 +1,113 @@
+"""Native host data-path (C++ collate.cpp via ctypes): build, semantics, and
+exact parity with the numpy fallbacks. The reference outsources this layer to
+torch's C++ (pad_sequence/DataLoader, reference:
+trlx/pipeline/ppo_pipeline.py:39-66); here it is first-party code and tested
+against its own fallback."""
+
+import numpy as np
+import pytest
+
+import trlx_tpu.native as native
+from trlx_tpu.native import RolloutBuffer, native_available, pad_ragged
+
+
+def test_native_builds():
+    assert native_available(), f"g++ build failed: {native._lib_err}"
+
+
+@pytest.mark.parametrize("left_pad", [True, False])
+@pytest.mark.parametrize("keep_last", [True, False])
+def test_pad_ragged_matches_fallback(monkeypatch, left_pad, keep_last):
+    rng = np.random.default_rng(0)
+    rows = [list(rng.integers(1, 100, rng.integers(0, 13))) for _ in range(37)]
+    got = pad_ragged(rows, max_len=8, pad_id=0, left_pad=left_pad, keep_last=keep_last)
+
+    monkeypatch.setattr(native, "_build_and_load", lambda: None)
+    want = pad_ragged(rows, max_len=8, pad_id=0, left_pad=left_pad, keep_last=keep_last)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_pad_ragged_disciplines():
+    ids, mask = pad_ragged([[1, 2, 3], [4], [5, 6, 7, 8, 9]], 4, pad_id=-1)
+    assert ids.tolist() == [[-1, 1, 2, 3], [-1, -1, -1, 4], [6, 7, 8, 9]]
+    assert mask.tolist() == [[0, 1, 1, 1], [0, 0, 0, 1], [1, 1, 1, 1]]
+    ids, _ = pad_ragged([[5, 6, 7, 8, 9]], 4, pad_id=0, left_pad=False, keep_last=False)
+    assert ids.tolist() == [[5, 6, 7, 8]]
+
+
+def _roundtrip(buf):
+    rng = np.random.default_rng(1)
+    a1 = rng.standard_normal((5, 3)).astype(np.float32)
+    b1 = rng.integers(0, 50, (5, 2)).astype(np.int32)
+    buf.push({"a": a1, "b": b1})
+    a2 = rng.standard_normal((4, 3)).astype(np.float32)
+    b2 = rng.integers(0, 50, (4, 2)).astype(np.int32)
+    buf.push({"a": a2, "b": b2})
+    assert len(buf) == 9
+    ixs = np.asarray([8, 0, 5, 5, 2])
+    g = buf.gather(ixs)
+    ref_a = np.concatenate([a1, a2])[ixs]
+    ref_b = np.concatenate([b1, b2])[ixs]
+    np.testing.assert_array_equal(g["a"], ref_a)
+    np.testing.assert_array_equal(g["b"], ref_b)
+    buf.clear()
+    assert len(buf) == 0
+
+
+def test_rollout_buffer_native():
+    buf = RolloutBuffer([("a", 3, np.float32), ("b", 2, np.int32)])
+    assert buf._lib is not None
+    _roundtrip(buf)
+
+
+def test_rollout_buffer_fallback(monkeypatch):
+    monkeypatch.setattr(native, "_build_and_load", lambda: None)
+    buf = RolloutBuffer([("a", 3, np.float32), ("b", 2, np.int32)])
+    assert buf._lib is None
+    _roundtrip(buf)
+
+
+def test_ppo_storage_roundtrip():
+    from trlx_tpu.data import PPORLElement
+    from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+    store = PPORolloutStorage(pad_token_id=0)
+    rng = np.random.default_rng(2)
+    P, R, N = 4, 3, 10
+    store.push_batch(
+        {
+            "query_tensors": rng.integers(0, 9, (N, P)),
+            "query_mask": np.ones((N, P), np.int32),
+            "response_tensors": rng.integers(0, 9, (N, R)),
+            "response_mask": np.ones((N, R), np.int32),
+            "logprobs": rng.standard_normal((N, R)).astype(np.float32),
+            "values": rng.standard_normal((N, R)).astype(np.float32),
+            "rewards": rng.standard_normal((N, R)).astype(np.float32),
+        }
+    )
+    # element API (reference-shaped) interops with the chunked path
+    e = store[3]
+    store.push([e, e])
+    assert len(store) == 12
+
+    loader = store.create_loader(batch_size=4, shuffle=True, seed=0)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0].query_tensors.shape == (4, P)
+    assert batches[0].logprobs.dtype == np.float32
+    store.clear_history()
+    assert len(store) == 0
+
+
+def test_gather_index_semantics():
+    buf = RolloutBuffer([("a", 2, np.int32)])
+    buf.push({"a": np.arange(10, dtype=np.int32).reshape(5, 2)})
+    # negative indices normalize Python-style before the unchecked C memcpy
+    np.testing.assert_array_equal(buf.gather(np.asarray([-1]))["a"], [[8, 9]])
+    with pytest.raises(IndexError):
+        buf.gather(np.asarray([5]))
+    with pytest.raises(IndexError):
+        buf.gather(np.asarray([-6]))
+    # empty chunk push is a no-op
+    assert buf.push({"a": np.zeros((0, 2), np.int32)}) == 5

@@ -1,0 +1,214 @@
+"""ctypes binding for the native host data-path (collate.cpp).
+
+Builds `collate.cpp` with g++ on first use (cached by source hash under
+`_build/`), and falls back to numpy implementations with identical semantics
+when no toolchain is available — so the framework is portable and the tests
+can assert native/fallback parity.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "collate.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+_lib = None
+_lib_err: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _build_and_load():
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            so_path = os.path.join(_BUILD_DIR, f"collate-{digest}.so")
+            if not os.path.exists(so_path):
+                tmp = so_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, so_path)  # atomic vs concurrent builders
+            lib = ctypes.CDLL(so_path)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            vpp = ctypes.POINTER(ctypes.c_void_p)
+            lib.pad_ragged_i32.argtypes = [
+                i32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+            ]
+            lib.rb_new.restype = ctypes.c_void_p
+            lib.rb_new.argtypes = [ctypes.c_int64, i64p]
+            lib.rb_free.argtypes = [ctypes.c_void_p]
+            lib.rb_clear.argtypes = [ctypes.c_void_p]
+            lib.rb_len.restype = ctypes.c_int64
+            lib.rb_len.argtypes = [ctypes.c_void_p]
+            lib.rb_push.restype = ctypes.c_int64
+            lib.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, vpp]
+            lib.rb_gather.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, vpp]
+            _lib = lib
+        except Exception as e:  # no toolchain / sandboxed build failure
+            _lib_err = str(e)
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def _as_i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _as_i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def pad_ragged(
+    token_lists: Sequence[Sequence[int]],
+    max_len: int,
+    pad_id: int,
+    left_pad: bool = True,
+    keep_last: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Ragged token lists → ([n, max_len] int32 ids, [n, max_len] int32 mask).
+
+    Overlong rows truncate keeping the trailing (keep_last, the prompt
+    convention) or leading tokens. The padding disciplines match the
+    reference's (left-pad queries / right-pad responses, reference:
+    trlx/pipeline/ppo_pipeline.py:39-66).
+    """
+    n = len(token_lists)
+    lib = _build_and_load()
+    out_ids = np.empty((n, max_len), dtype=np.int32)
+    out_mask = np.empty((n, max_len), dtype=np.int32)
+    if lib is not None:
+        lengths = np.fromiter((len(t) for t in token_lists), dtype=np.int64, count=n)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        flat = np.empty(int(offsets[-1]), dtype=np.int32)
+        for i, t in enumerate(token_lists):
+            flat[offsets[i] : offsets[i + 1]] = np.asarray(t, dtype=np.int32).reshape(-1)
+        lib.pad_ragged_i32(
+            _as_i32p(flat), _as_i64p(offsets), n, max_len, pad_id,
+            int(left_pad), int(keep_last), _as_i32p(out_ids), _as_i32p(out_mask),
+        )
+        return out_ids, out_mask
+
+    out_ids.fill(pad_id)
+    out_mask.fill(0)
+    for i, t in enumerate(token_lists):
+        row = np.asarray(t, dtype=np.int32).reshape(-1)
+        row = row[-max_len:] if keep_last else row[:max_len]
+        L = len(row)
+        sl = slice(max_len - L, max_len) if left_pad else slice(0, L)
+        out_ids[i, sl] = row
+        out_mask[i, sl] = 1
+    return out_ids, out_mask
+
+
+class RolloutBuffer:
+    """Contiguous column store of fixed-width rows.
+
+    fields: [(name, elems_per_row, np.float32 | np.int32)]. `push` appends a
+    chunk of rows per field ([n, elems] arrays); `gather` materializes a
+    batch for arbitrary row indices. Native (C++) when available, numpy
+    otherwise — identical semantics either way.
+    """
+
+    def __init__(self, fields: List[Tuple[str, int, type]]):
+        self.fields = [(n, int(e), np.dtype(d)) for n, e, d in fields]
+        for _, _, dt in self.fields:
+            assert dt.itemsize == 4, "RolloutBuffer fields must be 4-byte dtypes"
+        self._lib = _build_and_load()
+        if self._lib is not None:
+            elems = np.asarray([e for _, e, _ in self.fields], dtype=np.int64)
+            self._h = ctypes.c_void_p(self._lib.rb_new(len(self.fields), _as_i64p(elems)))
+        else:
+            self._chunks: Dict[str, List[np.ndarray]] = {n: [] for n, _, _ in self.fields}
+            self._consolidated: Optional[Dict[str, np.ndarray]] = None
+            self._rows = 0
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.rb_len(self._h))
+        return self._rows
+
+    def clear(self):
+        if self._lib is not None:
+            self._lib.rb_clear(self._h)
+        else:
+            self._chunks = {n: [] for n, _, _ in self.fields}
+            self._consolidated = None
+            self._rows = 0
+
+    def push(self, arrays: Dict[str, np.ndarray]) -> int:
+        n_rows = None
+        prepped = []
+        for name, elems, dt in self.fields:
+            a = np.ascontiguousarray(
+                np.asarray(arrays[name], dtype=dt).reshape(len(arrays[name]), elems)
+            )
+            n_rows = a.shape[0] if n_rows is None else n_rows
+            assert a.shape[0] == n_rows
+            prepped.append(a)
+        if n_rows == 0:
+            return len(self)
+        if self._lib is not None:
+            ptrs = (ctypes.c_void_p * len(prepped))(
+                *[a.ctypes.data_as(ctypes.c_void_p) for a in prepped]
+            )
+            return int(self._lib.rb_push(self._h, n_rows, ptrs))
+        for (name, _, _), a in zip(self.fields, prepped):
+            self._chunks[name].append(a)
+        self._consolidated = None
+        self._rows += n_rows
+        return self._rows
+
+    def gather(self, ixs: np.ndarray) -> Dict[str, np.ndarray]:
+        n = len(self)
+        ixs = np.asarray(ixs, dtype=np.int64)
+        # Python index semantics, enforced BEFORE the unchecked C memcpy.
+        if n == 0 and len(ixs):
+            raise IndexError("gather from an empty RolloutBuffer")
+        if len(ixs):
+            if int(ixs.min()) < -n or int(ixs.max()) >= n:
+                raise IndexError(f"gather indices out of range for {n} rows")
+            ixs = np.ascontiguousarray(np.where(ixs < 0, ixs + n, ixs))
+        out = {
+            name: np.empty((len(ixs), elems), dtype=dt)
+            for name, elems, dt in self.fields
+        }
+        if self._lib is not None:
+            ptrs = (ctypes.c_void_p * len(self.fields))(
+                *[out[n_].ctypes.data_as(ctypes.c_void_p) for n_, _, _ in self.fields]
+            )
+            self._lib.rb_gather(self._h, _as_i64p(ixs), len(ixs), ptrs)
+            return out
+        if self._consolidated is None:
+            self._consolidated = {
+                name: np.concatenate(self._chunks[name], axis=0)
+                for name, _, _ in self.fields
+            }
+        for name, _, _ in self.fields:
+            out[name] = self._consolidated[name][ixs]
+        return out
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.rb_free(h)

@@ -1,0 +1,115 @@
+// Native host data-path for trlx_tpu: ragged->padded collation and a
+// contiguous rollout column store.
+//
+// The reference's host data path is torch's C++ (DataLoader workers +
+// pad_sequence, reference: trlx/pipeline/ppo_pipeline.py:39-66 and
+// trlx/pipeline/offline_pipeline.py:12-35). torch is not part of the TPU
+// runtime here, so the equivalent native layer is this small library, built
+// with g++ at first use and bound via ctypes (trlx_tpu/native/__init__.py).
+// Python/numpy fallbacks exist for environments without a toolchain.
+//
+// Exposed C ABI:
+//   pad_ragged_i32   flat ragged tokens -> [n, max_len] ids + mask,
+//                    left/right padding, keep-first/keep-last truncation
+//   rb_new/rb_free/rb_clear/rb_len/rb_push/rb_gather
+//                    growable column store of fixed-width rows (the PPO
+//                    rollout store's backing memory): push appends row
+//                    chunks, gather materializes shuffled batches
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Column {
+  int64_t elems;        // elements per row
+  int64_t elem_size;    // bytes per element (4 for f32/i32)
+  std::vector<char> data;
+};
+
+struct RolloutBuffer {
+  int64_t rows = 0;
+  std::vector<Column> cols;
+};
+
+}  // namespace
+
+extern "C" {
+
+// flat: concatenated tokens; offsets: [n_rows+1] row boundaries.
+// left_pad: pad on the left (queries/prompts) vs right (responses).
+// keep_last: truncate overlong rows keeping the trailing tokens (prompt
+// convention: most recent context) vs leading.
+void pad_ragged_i32(const int32_t* flat, const int64_t* offsets, int64_t n_rows,
+                    int64_t max_len, int32_t pad_id, int32_t left_pad,
+                    int32_t keep_last, int32_t* out_ids, int32_t* out_mask) {
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int32_t* row = flat + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    const int32_t* src = row;
+    if (len > max_len) {
+      if (keep_last) src = row + (len - max_len);
+      len = max_len;
+    }
+    int32_t* ids = out_ids + i * max_len;
+    int32_t* mask = out_mask + i * max_len;
+    int64_t start = left_pad ? (max_len - len) : 0;
+    for (int64_t j = 0; j < max_len; ++j) {
+      ids[j] = pad_id;
+      mask[j] = 0;
+    }
+    std::memcpy(ids + start, src, len * sizeof(int32_t));
+    for (int64_t j = 0; j < len; ++j) mask[start + j] = 1;
+  }
+}
+
+void* rb_new(int64_t n_fields, const int64_t* field_elems) {
+  auto* rb = new RolloutBuffer();
+  rb->cols.resize(n_fields);
+  for (int64_t f = 0; f < n_fields; ++f) {
+    rb->cols[f].elems = field_elems[f];
+    rb->cols[f].elem_size = 4;
+  }
+  return rb;
+}
+
+void rb_free(void* h) { delete static_cast<RolloutBuffer*>(h); }
+
+void rb_clear(void* h) {
+  auto* rb = static_cast<RolloutBuffer*>(h);
+  rb->rows = 0;
+  for (auto& c : rb->cols) c.data.clear();
+}
+
+int64_t rb_len(void* h) { return static_cast<RolloutBuffer*>(h)->rows; }
+
+// field_ptrs[f] points at [n_rows, elems_f] contiguous row-major data.
+int64_t rb_push(void* h, int64_t n_rows, const void** field_ptrs) {
+  auto* rb = static_cast<RolloutBuffer*>(h);
+  for (size_t f = 0; f < rb->cols.size(); ++f) {
+    Column& c = rb->cols[f];
+    int64_t nbytes = n_rows * c.elems * c.elem_size;
+    size_t old = c.data.size();
+    c.data.resize(old + nbytes);
+    std::memcpy(c.data.data() + old, field_ptrs[f], nbytes);
+  }
+  rb->rows += n_rows;
+  return rb->rows;
+}
+
+// Gather rows ixs[0..n_ix) of every column into out_ptrs[f] ([n_ix, elems_f]).
+void rb_gather(void* h, const int64_t* ixs, int64_t n_ix, void** out_ptrs) {
+  auto* rb = static_cast<RolloutBuffer*>(h);
+  for (size_t f = 0; f < rb->cols.size(); ++f) {
+    Column& c = rb->cols[f];
+    int64_t row_bytes = c.elems * c.elem_size;
+    char* out = static_cast<char*>(out_ptrs[f]);
+    const char* src = c.data.data();
+    for (int64_t i = 0; i < n_ix; ++i) {
+      std::memcpy(out + i * row_bytes, src + ixs[i] * row_bytes, row_bytes);
+    }
+  }
+}
+
+}  // extern "C"

@@ -21,7 +21,6 @@ read) — the reference serializes these phases.
 
 import numpy as np
 
-from trlx_tpu.data import PPORLElement
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
 from trlx_tpu.utils import Clock
 
@@ -46,11 +45,14 @@ class PPOOrchestrator(Orchestrator):
         return self.rl_model.reward_fn(texts)
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
-        """Fill the trainer's rollout store with `num_rollouts` PPORLElements
-        (reference: trlx/orchestrator/ppo_orchestrator.py:50-130)."""
-        ppo_rl_elements = []
+        """Fill the trainer's rollout store with `num_rollouts` rollout rows
+        (reference: trlx/orchestrator/ppo_orchestrator.py:50-130). Rows are
+        pushed as whole chunks into the native column store
+        (trlx_tpu/native/collate.cpp) — no per-sample Python objects, unlike
+        the reference's PPORLElement list."""
+        n_collected = 0
         clock = Clock()
-        while len(ppo_rl_elements) < num_rollouts:
+        while n_collected < num_rollouts:
             try:
                 batch = next(self.pipeline_iterator)
             except StopIteration:
@@ -68,25 +70,19 @@ class PPOOrchestrator(Orchestrator):
             logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
 
             P = batch["input_ids"].shape[1]
-            q = np.asarray(tokens[:, :P])
-            qmask = np.asarray(mask[:, :P])
-            r = np.asarray(tokens[:, P:])
-            rmask = np.asarray(mask[:, P:])
-            logprobs, values, rewards = np.asarray(logprobs), np.asarray(values), np.asarray(rewards)
-
-            for i in range(q.shape[0]):
-                ppo_rl_elements.append(
-                    PPORLElement(
-                        query_tensor=q[i],
-                        response_tensor=r[i],
-                        logprobs=logprobs[i],
-                        values=values[i],
-                        rewards=rewards[i],
-                        response_mask=rmask[i],
-                        query_mask=qmask[i],
-                    )
-                )
+            tokens, mask = np.asarray(tokens), np.asarray(mask)
+            self.rl_model.store.push_batch(
+                {
+                    "query_tensors": tokens[:, :P],
+                    "query_mask": mask[:, :P],
+                    "response_tensors": tokens[:, P:],
+                    "response_mask": mask[:, P:],
+                    "logprobs": np.asarray(logprobs),
+                    "values": np.asarray(values),
+                    "rewards": np.asarray(rewards),
+                }
+            )
+            n_collected += tokens.shape[0]
 
         exp_time = clock.tick()
         self.rl_model.tracker.log({"exp_time": exp_time, "rollout_mean_score": float(np.mean(scores)), "rollout_mean_kl": float(np.mean(np.asarray(kl).sum(-1)))}, step=iter_count)
-        self.rl_model.push_to_store(ppo_rl_elements)

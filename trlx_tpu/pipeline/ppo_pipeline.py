@@ -1,42 +1,108 @@
-"""PPO rollout storage: fixed-shape numpy ring of PPORLElements.
+"""PPO rollout storage: contiguous native column store of rollout rows.
 
 Redesign of the reference's PPORolloutStorage
 (reference: trlx/pipeline/ppo_pipeline.py:11-68). Elements arrive already
 padded to static [P] / [R] shapes (queries left-padded, responses
 right-padded — the reference's exact padding discipline, reference:
 trlx/pipeline/ppo_pipeline.py:39-66 — but enforced at rollout time, so
-collation is a plain stack with no per-batch pad_sequence).
+collation is a row gather with no per-batch pad_sequence). The backing
+memory is the C++ RolloutBuffer (trlx_tpu/native/collate.cpp) — chunked
+pushes and batch gathers never touch per-element Python objects; the
+reference instead holds a Python list of tensor dataclasses and re-stacks
+them every batch.
 """
 
-from typing import Iterable, List
+from typing import Dict, Iterable
 
 import numpy as np
 
 from trlx_tpu.data import PPORLBatch, PPORLElement
 from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
 
+_FIELD_SPECS = (
+    ("query_tensors", "P", np.int32),
+    ("query_mask", "P", np.int32),
+    ("response_tensors", "R", np.int32),
+    ("response_mask", "R", np.int32),
+    ("logprobs", "R", np.float32),
+    ("values", "R", np.float32),
+    ("rewards", "R", np.float32),
+)
+
 
 class PPORolloutStorage(BaseRolloutStore):
     def __init__(self, pad_token_id: int = 0):
         super().__init__()
         self.pad_token_id = pad_token_id
-        self.history: List[PPORLElement] = []
+        self._buffer = None  # created lazily at first push (widths from data)
+
+    def _ensure_buffer(self, P: int, R: int):
+        if self._buffer is None:
+            from trlx_tpu.native import RolloutBuffer
+
+            widths = {"P": P, "R": R}
+            self._buffer = RolloutBuffer(
+                [(name, widths[w], dt) for name, w, dt in _FIELD_SPECS]
+            )
+        return self._buffer
+
+    def push_batch(self, arrays: Dict[str, np.ndarray]) -> int:
+        """Append a chunk of rollout rows (the orchestrator's fast path)."""
+        buf = self._ensure_buffer(
+            np.asarray(arrays["query_tensors"]).shape[1],
+            np.asarray(arrays["response_tensors"]).shape[1],
+        )
+        return buf.push(arrays)
 
     def push(self, exps: Iterable[PPORLElement]):
-        self.history += list(exps)
+        """Reference-shaped API: a list of per-sample elements."""
+        exps = list(exps)
+        if not exps:
+            return
+        self.push_batch(
+            {
+                "query_tensors": np.stack([e.query_tensor for e in exps]),
+                "query_mask": np.stack([e.query_mask for e in exps]),
+                "response_tensors": np.stack([e.response_tensor for e in exps]),
+                "response_mask": np.stack([e.response_mask for e in exps]),
+                "logprobs": np.stack([e.logprobs for e in exps]),
+                "values": np.stack([e.values for e in exps]),
+                "rewards": np.stack([e.rewards for e in exps]),
+            }
+        )
+
+    def clear_history(self):
+        if self._buffer is not None:
+            self._buffer.clear()
+
+    def __len__(self) -> int:
+        return 0 if self._buffer is None else len(self._buffer)
+
+    def __getitem__(self, ix: int) -> PPORLElement:
+        g = self._buffer.gather(np.asarray([ix]))
+        return PPORLElement(
+            query_tensor=g["query_tensors"][0],
+            response_tensor=g["response_tensors"][0],
+            logprobs=g["logprobs"][0],
+            values=g["values"][0],
+            rewards=g["rewards"][0],
+            response_mask=g["response_mask"][0],
+            query_mask=g["query_mask"][0],
+        )
 
     def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> BatchLoader:
-        history = self.history
+        buffer = self._buffer
 
         def collate(ixs):
+            g = buffer.gather(np.asarray(ixs))
             return PPORLBatch(
-                query_tensors=np.stack([history[i].query_tensor for i in ixs]),
-                response_tensors=np.stack([history[i].response_tensor for i in ixs]),
-                logprobs=np.stack([history[i].logprobs for i in ixs]),
-                values=np.stack([history[i].values for i in ixs]),
-                rewards=np.stack([history[i].rewards for i in ixs]),
-                response_mask=np.stack([history[i].response_mask for i in ixs]),
-                query_mask=np.stack([history[i].query_mask for i in ixs]),
+                query_tensors=g["query_tensors"],
+                response_tensors=g["response_tensors"],
+                logprobs=g["logprobs"],
+                values=g["values"],
+                rewards=g["rewards"],
+                response_mask=g["response_mask"],
+                query_mask=g["query_mask"],
             )
 
-        return BatchLoader(len(history), batch_size, collate, shuffle=shuffle, drop_last=True, seed=seed)
+        return BatchLoader(len(self), batch_size, collate, shuffle=shuffle, drop_last=True, seed=seed)

@@ -33,26 +33,23 @@ class PromptPipeline(BasePipeline):
         if tokenizer is not None:
             # BOS prepended like the reference's tokenize()
             # (reference: trlx/model/accelerate_base_model.py:93-103).
-            token_lists = []
-            for text in prompts:
-                ids = tokenizer(text, add_special_tokens=False)["input_ids"]
-                if add_bos and tokenizer.bos_token_id is not None:
-                    ids = [tokenizer.bos_token_id] + ids
-                token_lists.append(ids[-max_prompt_length:])
+            bos = [tokenizer.bos_token_id] if (add_bos and tokenizer.bos_token_id is not None) else []
+            token_lists = [
+                bos + tokenizer(text, add_special_tokens=False)["input_ids"]
+                for text in prompts
+            ]
             pad_id = tokenizer.pad_token_id if tokenizer.pad_token_id is not None else 0
         else:
-            token_lists = [list(np.asarray(p).reshape(-1)) for p in prompts]
-            token_lists = [t[-max_prompt_length:] for t in token_lists]
+            token_lists = [np.asarray(p).reshape(-1) for p in prompts]
             pad_id = 0
 
-        n = len(token_lists)
-        P = max_prompt_length
-        self.input_ids = np.full((n, P), pad_id, dtype=np.int32)
-        self.attention_mask = np.zeros((n, P), dtype=np.int32)
-        for i, ids in enumerate(token_lists):
-            L = len(ids)
-            self.input_ids[i, P - L :] = ids
-            self.attention_mask[i, P - L :] = 1
+        # Left-pad, keep-last truncation — in the native collator
+        # (trlx_tpu/native/collate.cpp) when built, numpy otherwise.
+        from trlx_tpu.native import pad_ragged
+
+        self.input_ids, self.attention_mask = pad_ragged(
+            token_lists, max_prompt_length, pad_id, left_pad=True, keep_last=True
+        )
         self.pad_id = pad_id
 
     def __len__(self) -> int:
