@@ -77,3 +77,25 @@ def test_checkpoint_save_load(task, tmp_path):
     step_before = int(jax.device_get(model.state.step))
     model.load()
     assert int(jax.device_get(model.state.step)) == step_before
+
+
+def test_ppo_fully_unfrozen_uses_ref_copy(task, tmp_path):
+    """num_layers_unfrozen >= n_layer means no shared trunk: the trainer must
+    fall back to a full frozen ref copy (a layer-0 branch replay would
+    re-apply position embeddings — regression test)."""
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = 2
+    config.model.num_layers_unfrozen = config.model.model_arch["n_layer"]
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.model.branch_layer == -1
+    assert model.iter_count >= 2

@@ -280,12 +280,35 @@ class JaxBaseTrainer(BaseRLTrainer):
         post_epoch_callback."""
         self.prepare_learning()
         self.iter_count = 0
-        clock = Clock()
 
+        # jax.profiler trace of a few steady-state steps (reference has
+        # wall-clock timers only, SURVEY.md §5; XLA traces are the TPU-native
+        # upgrade). Steps [2, 5): past compilation, short enough to inspect.
+        profile_dir = self.config.train.profile_dir
+        self._profiling = False
+
+        def profiler_tick():
+            if not profile_dir or not is_main_process():
+                return
+            if self.iter_count == 2 and not self._profiling:
+                jax.profiler.start_trace(profile_dir)
+                self._profiling = True
+            elif self._profiling and self.iter_count >= 5:
+                jax.profiler.stop_trace()
+                self._profiling = False
+
+        try:
+            return self._learn_loop(profiler_tick)
+        finally:
+            if self._profiling:
+                jax.profiler.stop_trace()
+
+    def _learn_loop(self, profiler_tick):
         for epoch in range(self.config.train.epochs):
             for batch in self.train_dataloader:
                 device_batch = self.put_batch(batch)
                 for _ in range(self.n_updates_per_batch):
+                    profiler_tick()
                     forward_t0 = time.time()
                     self.state, stats = self.train_step(self.state, device_batch)
                     self.iter_count += 1
@@ -293,14 +316,14 @@ class JaxBaseTrainer(BaseRLTrainer):
                     intervals = self.intervals(self.iter_count)
                     if intervals["do_checkpoint"]:
                         self.save()
+                    # Reading stats forces a device sync — the price of
+                    # per-step logging, as in the reference's per-step
+                    # accelerator.log (reference:
+                    # trlx/model/accelerate_base_model.py:244).
+                    stats_host = {k: float(v) for k, v in stats.items()}
                     if intervals["do_eval"]:
-                        stats_host = {k: float(v) for k, v in stats.items()}
                         stats_host.update(self.evaluate())
-                        self.tracker.log(stats_host, step=self.iter_count)
-                    else:
-                        # async-friendly: only sync/log every log step
-                        stats_host = {k: float(v) for k, v in stats.items()}
-                        self.tracker.log(stats_host, step=self.iter_count)
+                    self.tracker.log(stats_host, step=self.iter_count)
                     stats_host["step_time"] = time.time() - forward_t0
                     stats_host["samples_per_sec"] = (
                         self.config.train.batch_size / max(stats_host["step_time"], 1e-9)

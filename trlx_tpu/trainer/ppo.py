@@ -121,7 +121,10 @@ class PPOTrainer(JaxBaseTrainer):
 
         lm_cfg = self.finalize_lm_config(build_lm_config(config))
         k = config.model.num_layers_unfrozen
-        branch_layer = lm_cfg.n_layer - k if k > 0 else -1
+        # k >= n_layer means nothing is shared with the ref model — same as
+        # fully unfrozen: keep a complete frozen param copy instead of a
+        # branch (a branch at layer 0 would re-apply position embeddings).
+        branch_layer = lm_cfg.n_layer - k if 0 < k < lm_cfg.n_layer else -1
         model = LMWithValueHead(lm_cfg, branch_layer=branch_layer)
         params = load_or_init_params(model, config, self.rng)
         return model, params
