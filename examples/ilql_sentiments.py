@@ -21,12 +21,9 @@ def build_metric_fn():
     )
 
     def metric_fn(samples):
-        outputs = sentiment_fn(samples)
-        return {
-            "sentiments": [
-                next(d["score"] for d in out if d["label"] == "POSITIVE") for out in outputs
-            ]
-        }
+        from trlx_tpu.utils import sentiment_score
+
+        return {"sentiments": sentiment_score(sentiment_fn(samples))}
 
     return metric_fn
 

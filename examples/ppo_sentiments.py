@@ -25,10 +25,9 @@ def build_reward_fn():
 
     def reward_fn(samples):
         # score of the POSITIVE class, order-stable regardless of ranking
-        outputs = sentiment_fn(samples)
-        return [
-            next(d["score"] for d in out if d["label"] == "POSITIVE") for out in outputs
-        ]
+        from trlx_tpu.utils import sentiment_score
+
+        return sentiment_score(sentiment_fn(samples))
 
     return reward_fn
 

@@ -60,3 +60,12 @@ def test_all_shipped_configs_load():
     for path in paths:
         cfg = TRLConfig.load_yaml(path)
         assert cfg.train.batch_size > 0, path
+
+
+def test_sentiment_score_shapes():
+    from trlx_tpu.utils import sentiment_score
+
+    top1 = [{"label": "POSITIVE", "score": 0.9}, {"label": "NEGATIVE", "score": 0.8}]
+    assert sentiment_score(top1) == [0.9, pytest.approx(0.2)]
+    all_scores = [[{"label": "NEGATIVE", "score": 0.3}, {"label": "POSITIVE", "score": 0.7}]]
+    assert sentiment_score(all_scores) == [pytest.approx(0.7)]

@@ -72,6 +72,7 @@ class LMWithValueHead(nn.Module):
         cache_mask=None,
         collect_branch_hidden: bool = False,
         prepend_soft: bool = True,
+        logits_start: int = 0,
     ):
         out = self.transformer(
             input_ids=input_ids,
@@ -83,6 +84,7 @@ class LMWithValueHead(nn.Module):
             cache_mask=cache_mask,
             collect_hidden_at=self.branch_layer if (collect_branch_hidden and self.branch_layer >= 0) else None,
             prepend_soft=prepend_soft,
+            logits_start=logits_start,
         )
         values = self.v_head(out["hidden"])[..., 0]
         return {
@@ -93,7 +95,7 @@ class LMWithValueHead(nn.Module):
             "cache": out["cache"],
         }
 
-    def forward_branch(self, branch_hidden, attention_mask=None, position_ids=None):
+    def forward_branch(self, branch_hidden, attention_mask=None, position_ids=None, logits_start: int = 0):
         """Replay blocks [branch_layer..N) + ln_f + lm head from the
         branch-point hidden states. Called via
         ``model.apply({'params': ref_branch_params}, ..., method='forward_branch')``
@@ -104,6 +106,7 @@ class LMWithValueHead(nn.Module):
             attention_mask=attention_mask,
             position_ids=position_ids,
             start_layer=self.branch_layer,
+            logits_start=logits_start,
         )
         return out["logits"]
 

@@ -359,6 +359,7 @@ class TransformerLM(nn.Module):
         stop_layer: Optional[int] = None,
         collect_hidden_at: Optional[int] = None,
         compute_logits: bool = True,
+        logits_start: int = 0,
         prepend_soft: bool = True,
     ):
         """Returns dict(logits, hidden, branch_hidden, cache).
@@ -486,8 +487,12 @@ class TransformerLM(nn.Module):
 
         logits = None
         if compute_logits:
+            # RL losses/scoring only need logits from the first response
+            # position on — slicing before the head skips ~P/T of the
+            # vocab-projection FLOPs and the fp32 logit memory.
+            x_head = x[:, logits_start:] if logits_start else x
             if cfg.tie_word_embeddings:
-                logits = wte.attend(x)
+                logits = wte.attend(x_head)
             else:
                 logits = nn.Dense(
                     cfg.vocab_size,
@@ -495,7 +500,7 @@ class TransformerLM(nn.Module):
                     param_dtype=cfg.params_dtype,
                     use_bias=cfg.extra.get("lm_head_bias", False),
                     name="lm_head",
-                )(x)
+                )(x_head)
 
         return {
             "logits": logits,

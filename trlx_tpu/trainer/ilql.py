@@ -124,7 +124,39 @@ class ILQLTrainer(JaxBaseTrainer):
         # Swap TARGET Q heads into the applied params: decode steers by the
         # target network (reference: trlx/model/nn/ilql_models.py:203-206).
         params = {**self.state.params, **self.state.extras}
-        return self._generate_fn({"params": params}, batch["i"], batch["m"], self.next_rng())
+        tokens, mask = self._generate_fn({"params": params}, batch["i"], batch["m"], self.next_rng())
+        # Process-UNIFORM condition (single-host, tracking not disabled): a
+        # rank-gated jitted forward would deadlock an SPMD pod. ILQL generate
+        # runs only from evaluate() (offline method — no online rollouts), so
+        # the extra stats forward is off the training path.
+        if jax.process_count() == 1 and "debug" not in __import__("os").environ:
+            self._log_decode_stats(params, tokens, mask)
+        return tokens, mask
+
+    def _log_decode_stats(self, params, tokens, mask):
+        """Q/V/advantage distributions over the DECODED tokens only
+        (≈ the wandb.Histograms the reference collects inside its Python
+        decode loop, reference: trlx/model/nn/ilql_models.py:238-249)."""
+        P = self.prompt_length
+        if not hasattr(self, "_decode_stats_fn"):
+            def impl(params, tokens, mask):
+                out = self.model.apply({"params": params}, tokens, mask)
+                qs = out["qs"]
+                q = jnp.minimum(qs[0], qs[1]) if len(qs) > 1 else qs[0]
+                q_taken = jnp.take_along_axis(
+                    q[:, :-1].astype(jnp.float32), tokens[:, 1:, None], axis=-1
+                )[..., 0]
+                vs = out["vs"].astype(jnp.float32)[:, :-1]
+                # transitions j -> token j+1; decoded tokens start at P
+                decoded = jnp.arange(tokens.shape[1] - 1) >= P - 1
+                return q_taken, vs, q_taken - vs, mask[:, 1:] * decoded[None, :]
+
+            self._decode_stats_fn = jax.jit(impl)
+        q_taken, vs, adv, valid = jax.device_get(self._decode_stats_fn(params, tokens, mask))
+        valid = valid.astype(bool)
+        self.tracker.log_histogram("decode/qs", q_taken[valid], step=self.iter_count)
+        self.tracker.log_histogram("decode/vs", vs[valid], step=self.iter_count)
+        self.tracker.log_histogram("decode/adv", adv[valid], step=self.iter_count)
 
     # ------------------------------------------------------------ train step
 

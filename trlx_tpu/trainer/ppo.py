@@ -147,21 +147,26 @@ class PPOTrainer(JaxBaseTrainer):
 
     def _rollout_score_impl(self, params, extras, tokens, mask, scores, kl_coef, *, prompt_length: int):
         P = prompt_length
-        out = self.model.apply({"params": params}, tokens, mask, collect_branch_hidden=True)
+        # logits_start=P-1: the vocab projection + fp32 softmax run only over
+        # the response region [P-1, T) — the prompt's logits are never needed.
+        out = self.model.apply(
+            {"params": params}, tokens, mask, collect_branch_hidden=True, logits_start=P - 1
+        )
         logits = out["logits"].astype(jnp.float32)
         if self.model.branch_layer >= 0:
             ref_logits = self.model.apply(
-                {"params": extras}, out["branch_hidden"], mask, method="forward_branch"
+                {"params": extras}, out["branch_hidden"], mask,
+                method="forward_branch", logits_start=P - 1,
             ).astype(jnp.float32)
         else:
-            ref_logits = self.model.apply({"params": extras}, tokens, mask)["logits"].astype(jnp.float32)
+            ref_logits = self.model.apply(
+                {"params": extras}, tokens, mask, logits_start=P - 1
+            )["logits"].astype(jnp.float32)
 
-        logprobs = logprobs_from_logits(logits[:, :-1], tokens[:, 1:])
-        ref_logprobs = logprobs_from_logits(ref_logits[:, :-1], tokens[:, 1:])
         # Response region, state-before-token convention [P-1, P+R-1)
         # (reference: trlx/orchestrator/ppo_orchestrator.py:94-98).
-        lp = logprobs[:, P - 1 :]
-        rlp = ref_logprobs[:, P - 1 :]
+        lp = logprobs_from_logits(logits[:, :-1], tokens[:, P:])
+        rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
         values = out["values"].astype(jnp.float32)[:, P - 1 : -1]
         rmask = mask[:, P:]
         rewards, kl = kl_penalty_rewards(lp, rlp, rmask, scores, kl_coef)
@@ -189,10 +194,9 @@ class PPOTrainer(JaxBaseTrainer):
         def loss_fn(params, batch: PPORLBatch):
             all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
             all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
-            out = model.apply({"params": params}, all_ids, all_mask)
+            out = model.apply({"params": params}, all_ids, all_mask, logits_start=P - 1)
             logits = out["logits"].astype(jnp.float32)
-            logprobs = logprobs_from_logits(logits[:, :-1], all_ids[:, 1:])
-            lp = logprobs[:, P - 1 :]
+            lp = logprobs_from_logits(logits[:, :-1], all_ids[:, P:])
             vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
             return ppo_loss(
                 lp,

@@ -88,3 +88,20 @@ def tree_param_count(tree) -> int:
 def to_host(tree):
     """Device→host transfer of a pytree (numpy)."""
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def sentiment_score(sentiment_outputs):
+    """Positive-class probabilities from HF sentiment-pipeline outputs
+    (capability counterpart of the reference's sentiment_score util,
+    reference: trlx/utils/__init__.py:109-116). Accepts either
+    top-1 dicts ({label, score}) or per-class score lists."""
+    scores = []
+    for out in sentiment_outputs:
+        if isinstance(out, list):  # pipeline(..., return_all_scores=True)
+            by_label = {str(x["label"]).upper(): float(x["score"]) for x in out}
+            pos = by_label.get("POSITIVE", by_label.get("LABEL_1", 0.0))
+        else:
+            label = str(out.get("label", "")).upper()
+            pos = float(out["score"]) if label in ("POSITIVE", "LABEL_1") else 1.0 - float(out["score"])
+        scores.append(pos)
+    return scores

@@ -79,6 +79,36 @@ class Tracker:
         self._file.write(json.dumps({"table": name, "step": step, "columns": list(columns), "rows": [[str(c) for c in r] for r in rows[:32]]}) + "\n")
         self._file.flush()
 
+    def log_histogram(self, name: str, values, step: Optional[int] = None):
+        """Distribution logging (≈ wandb.Histogram of qs/vs/adv during ILQL
+        decode, reference: trlx/model/nn/ilql_models.py:238-249). Fallback
+        records summary statistics to the JSONL."""
+        if not self.enabled:
+            return
+        import numpy as np
+
+        values = np.asarray(values, dtype=np.float32).reshape(-1)
+        if values.size == 0:
+            return
+        if self._wandb is not None:
+            self._wandb.log({name: wandb.Histogram(values)}, step=step)
+        self._file.write(
+            json.dumps(
+                {
+                    "histogram": name,
+                    "step": step,
+                    "count": int(values.size),
+                    "mean": float(values.mean()),
+                    "std": float(values.std()),
+                    "min": float(values.min()),
+                    "p50": float(np.median(values)),
+                    "max": float(values.max()),
+                }
+            )
+            + "\n"
+        )
+        self._file.flush()
+
     def finish(self):
         if self._wandb is not None:
             self._wandb.finish()
