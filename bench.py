@@ -6,7 +6,7 @@ Prints ONE JSON line:
 Measures the full PPO cadence — compiled rollout generation (prefill +
 while_loop decode), fused rollout scoring, and ppo_epochs donated train steps
 — on a GPT-J-family model sized to the chip (BENCH_PRESET env: tiny|small|
-medium). The reference publishes no numbers (BASELINE.md); the recorded
+medium|long; long runs seq-1024 through the pallas flash path). The reference publishes no numbers (BASELINE.md); the recorded
 Accelerate-GPU comparison baseline is 1.0 samples/sec/chip until a measured
 reference lands, so vs_baseline == value.
 """
@@ -24,6 +24,9 @@ PRESETS = {
     "tiny": (2, 256, 8, 1024, 16, 32, 16),
     "small": (8, 1024, 16, 50400, 16, 32, 16),
     "medium": (16, 2048, 16, 50400, 16, 32, 8),
+    # long-context: seq 1024 routes scoring/training attention through the
+    # pallas flash kernel (and the sp ring when run on an sp>1 mesh)
+    "long": (8, 1024, 16, 50400, 768, 256, 4),
 }
 
 

@@ -99,3 +99,47 @@ def test_ppo_fully_unfrozen_uses_ref_copy(task, tmp_path):
     )
     assert model.model.branch_layer == -1
     assert model.iter_count >= 2
+
+
+def test_preemption_checkpoints_and_stops(task, tmp_path):
+    """SIGTERM mid-training must save a resumable checkpoint at the next step
+    boundary and stop cleanly (the reference has no preemption handling)."""
+    import os
+    import signal
+
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.epochs = 100
+    config.train.total_steps = 50  # would run long; preemption cuts it short
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    fired = {"done": False}
+    orig = PPOTrainer.post_backward_callback
+
+    def fire_once(self, stats=None):
+        orig(self, stats)
+        if not fired["done"] and self.iter_count >= 2:
+            fired["done"] = True
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    PPOTrainer.post_backward_callback = fire_once
+    try:
+        model = trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=[[i] for i in range(1, 15)],
+            metric_fn=metric_fn,
+            config=config,
+            logit_mask=logit_mask,
+        )
+    finally:
+        PPOTrainer.post_backward_callback = orig
+
+    assert fired["done"]
+    assert model.iter_count < 50  # stopped at the preemption boundary
+    with open(os.path.join(str(tmp_path), "latest.txt")) as f:
+        assert f.read().strip()
+    model.load()  # the checkpoint restores

@@ -297,15 +297,48 @@ class JaxBaseTrainer(BaseRLTrainer):
                 jax.profiler.stop_trace()
                 self._profiling = False
 
+        # Preemption/failure handling the reference lacks entirely ("crash =
+        # job death", SURVEY.md §5): SIGTERM (TPU preemption notice, k8s
+        # eviction) requests a checkpoint at the next safe boundary, so a
+        # resumable state lands before the VM disappears. Single-host only:
+        # the orbax save is collective, and an unsynchronized per-process
+        # flag would deadlock a pod (multi-host wants process-agreed
+        # preemption, e.g. orbax CheckpointManager's sync point).
+        import signal
+
+        self._preempted = False
+
+        def on_sigterm(signum, frame):
+            self._preempted = True
+
+        old_handler = None
+        if jax.process_count() == 1:
+            try:
+                old_handler = signal.signal(signal.SIGTERM, on_sigterm)
+            except ValueError:  # not in main thread
+                pass
+
         try:
             return self._learn_loop(profiler_tick)
         finally:
             if self._profiling:
                 jax.profiler.stop_trace()
+            if old_handler is not None:
+                signal.signal(signal.SIGTERM, old_handler)
+
+    def _save_on_preemption(self):
+        self.save()
+        self.tracker.log({"preempted_at_step": self.iter_count}, step=self.iter_count)
 
     def _learn_loop(self, profiler_tick):
         for epoch in range(self.config.train.epochs):
             for batch in self.train_dataloader:
+                # SIGTERM may land during the (long) rollout phase that
+                # rebuilt this dataloader — checkpoint before spending a
+                # further step on a doomed VM.
+                if self._preempted:
+                    self._save_on_preemption()
+                    return None
                 device_batch = self.put_batch(batch)
                 for _ in range(self.n_updates_per_batch):
                     profiler_tick()
@@ -330,6 +363,10 @@ class JaxBaseTrainer(BaseRLTrainer):
                     )
 
                     self.post_backward_callback(stats_host)
+
+                    if self._preempted:
+                        self._save_on_preemption()
+                        return None
 
                     if self.iter_count >= self.total_steps:
                         self.save()
