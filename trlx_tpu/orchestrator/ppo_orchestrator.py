@@ -44,32 +44,47 @@ class PPOOrchestrator(Orchestrator):
         (reference: trlx/orchestrator/ppo_orchestrator.py:45-49)."""
         return self.rl_model.reward_fn(texts)
 
+    def _generate_next_chunk(self):
+        try:
+            batch = next(self.pipeline_iterator)
+        except StopIteration:
+            self.pipeline_iterator = iter(self.pipeline_loader)
+            batch = next(self.pipeline_iterator)
+        P = batch["input_ids"].shape[1]
+        # Dispatched, not awaited: jax queues the compiled prefill+decode
+        # program and returns immediately.
+        tokens, mask = self.rl_model.rollout_generate(batch["input_ids"], batch["attention_mask"])
+        return tokens, mask, P
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Fill the trainer's rollout store with `num_rollouts` rollout rows
-        (reference: trlx/orchestrator/ppo_orchestrator.py:50-130). Rows are
-        pushed as whole chunks into the native column store
-        (trlx_tpu/native/collate.cpp) — no per-sample Python objects, unlike
-        the reference's PPORLElement list."""
+        (reference: trlx/orchestrator/ppo_orchestrator.py:50-130).
+
+        PIPELINED: the next chunk's generation is dispatched to the device
+        BEFORE the current chunk crosses the host boundary (decode +
+        reward_fn), so the TPU decodes chunk i+1 while the host scores chunk
+        i — the rollout/overlap idea of the pipeline-RLHF line of work
+        (PAPERS.md), which the reference serializes. Rows are pushed as whole
+        chunks into the native column store (trlx_tpu/native/collate.cpp) —
+        no per-sample Python objects."""
         n_collected = 0
         clock = Clock()
-        while n_collected < num_rollouts:
-            try:
-                batch = next(self.pipeline_iterator)
-            except StopIteration:
-                self.pipeline_iterator = iter(self.pipeline_loader)
-                batch = next(self.pipeline_iterator)
+        pending = self._generate_next_chunk()
+        while True:
+            tokens, mask, P = pending
+            chunk_rows = int(tokens.shape[0])  # static shape — no device sync
+            need_more = n_collected + chunk_rows < num_rollouts
+            if need_more:
+                pending = self._generate_next_chunk()
 
-            # Device: generate (jitted prefill+decode loop).
-            tokens, mask = self.rl_model.rollout_generate(batch["input_ids"], batch["attention_mask"])
-
-            # Host boundary: decode → user reward_fn.
+            # Host boundary: decode → user reward_fn (overlaps the pending
+            # generation running on device).
             texts_or_tokens = self.rl_model.decode(tokens, mask)
             scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
 
             # Device: score rollouts (logprobs/values/ref-KL rewards fused).
             logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
 
-            P = batch["input_ids"].shape[1]
             tokens, mask = np.asarray(tokens), np.asarray(mask)
             self.rl_model.store.push_batch(
                 {
@@ -82,7 +97,9 @@ class PPOOrchestrator(Orchestrator):
                     "rewards": np.asarray(rewards),
                 }
             )
-            n_collected += tokens.shape[0]
+            n_collected += chunk_rows
+            if not need_more:
+                break
 
         exp_time = clock.tick()
         self.rl_model.tracker.log({"exp_time": exp_time, "rollout_mean_score": float(np.mean(scores)), "rollout_mean_kl": float(np.mean(np.asarray(kl).sum(-1)))}, step=iter_count)

@@ -312,9 +312,11 @@ class JaxBaseTrainer(BaseRLTrainer):
             self._preempted = True
 
         old_handler = None
+        handler_installed = False
         if jax.process_count() == 1:
             try:
                 old_handler = signal.signal(signal.SIGTERM, on_sigterm)
+                handler_installed = True
             except ValueError:  # not in main thread
                 pass
 
@@ -323,8 +325,11 @@ class JaxBaseTrainer(BaseRLTrainer):
         finally:
             if self._profiling:
                 jax.profiler.stop_trace()
-            if old_handler is not None:
-                signal.signal(signal.SIGTERM, old_handler)
+            if handler_installed:
+                # old_handler may be None (disposition installed outside
+                # Python) — restore to default in that case rather than
+                # leaking our handler.
+                signal.signal(signal.SIGTERM, old_handler if old_handler is not None else signal.SIG_DFL)
 
     def _save_on_preemption(self):
         self.save()
