@@ -78,6 +78,38 @@ def match_partition_rules(rules: Sequence[Tuple[str, P]], tree: Any) -> Any:
     return jax.tree_util.tree_map_with_path(match, tree)
 
 
+def sanitize_specs(mesh, tree: Any, specs: Any) -> Any:
+    """Drop per-dimension sharding that does not divide the dim evenly
+    (tiny/odd vocab or head counts on a big mesh) — those dims replicate
+    instead of erroring at device_put."""
+    import warnings
+
+    import numpy as _np
+
+    def fix(leaf, spec):
+        if not isinstance(spec, P):
+            return spec
+        dims = []
+        for i, d in enumerate(spec):
+            if d is None:
+                dims.append(None)
+                continue
+            names = d if isinstance(d, tuple) else (d,)
+            size = int(_np.prod([mesh.shape[n] for n in names]))
+            if i < leaf.ndim and leaf.shape[i] % size == 0:
+                dims.append(d)
+            else:
+                warnings.warn(
+                    f"replicating dim {i} of a {tuple(leaf.shape)} param: "
+                    f"not divisible by mesh axes {names} (size {size}) — "
+                    "expect higher per-chip memory for this tensor"
+                )
+                dims.append(None)
+        return P(*dims)
+
+    return jax.tree_util.tree_map(fix, tree, specs)
+
+
 def specs_to_shardings(mesh, specs: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
@@ -92,7 +124,7 @@ def shard_pytree(tree: Any, mesh, rules: Sequence[Tuple[str, P]] = None) -> Tupl
     — param placement + ZeRO partitioning in one device_put.
     """
     rules = rules if rules is not None else lm_partition_rules()
-    specs = match_partition_rules(rules, tree)
+    specs = sanitize_specs(mesh, tree, match_partition_rules(rules, tree))
     shardings = specs_to_shardings(mesh, specs)
     sharded = jax.device_put(tree, shardings)
     return sharded, shardings
