@@ -146,13 +146,9 @@ def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray, rotary_dim:
 
 
 def _flash_block(q_len: int) -> int:
-    # 512x512 blocks measured best on v5e (7.7ms vs einsum 10.7ms at
-    # b=4,T=2048,h=16,d=64); fall to the largest 128-multiple that divides
-    # q_len (768 → 256), else a single whole-length block.
-    for blk in (512, 256, 128):
-        if q_len % blk == 0:
-            return blk
-    return q_len
+    from trlx_tpu.ops.flash_attention import pick_block
+
+    return pick_block(q_len)
 
 
 def ring_eligible(cfg: LMConfig, q_len: int, has_cache: bool, batch: Optional[int] = None) -> bool:
@@ -185,11 +181,9 @@ def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool) -> bool:
     if has_cache or cfg.attn_impl == "xla" or not _HAVE_PLTPU:
         return False
     if cfg.attn_impl == "auto":
-        # auto never picks interpret-mode pallas: off-TPU the einsum path is
-        # far faster. Tests reach the kernels via attn_impl="flash".
-        if jax.default_backend() != "tpu":
-            return False
-        return q_len >= 256 and q_len % 128 == 0
+        from trlx_tpu.ops.flash_attention import auto_flash_ok
+
+        return auto_flash_ok(q_len)
     return True
 
 

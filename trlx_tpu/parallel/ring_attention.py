@@ -41,14 +41,30 @@ MASK_VAL = -1e9
 M_INIT = -1e30
 
 
+def _flash_in_ring_ok(t: int, use_flash) -> bool:
+    if use_flash is not None:
+        return bool(use_flash)
+    from trlx_tpu.ops.flash_attention import auto_flash_ok
+
+    return auto_flash_ok(t)
+
+
 def ring_attention(q, k, v, kv_mask, *, axis_name: str, n_ring: int, scale: float,
-                   causal: bool = True, window: int = 0):
+                   causal: bool = True, window: int = 0, use_flash=None):
     """Per-device body (call inside shard_map over `axis_name`).
 
     q/k/v: [b, t_local, h, d] — this device's sequence chunk, rotary already
     applied. kv_mask: [b, t_local] key validity (left padding). Returns
     [b, t_local, h, d] attention outputs for the local queries.
+
+    Two per-chunk engines: the pallas flash kernel (long aligned chunks on
+    TPU; exact cross-chunk combination via the kernel's log-sum-exp output,
+    with the visiting chunk's displacement passed as the kernel offset) or an
+    XLA einsum online-softmax (everything else). `use_flash` forces a path.
     """
+    if _flash_in_ring_ok(q.shape[1], use_flash):
+        return _ring_flash(q, k, v, kv_mask, axis_name=axis_name, n_ring=n_ring,
+                           scale=scale, causal=causal, window=window)
     b, t, h, d = q.shape
     idx = jax.lax.axis_index(axis_name)
     q_pos = idx * t + jnp.arange(t)  # global positions of local queries
@@ -100,8 +116,55 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str, n_ring: int, scale: floa
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ring_flash(q, k, v, kv_mask, *, axis_name: str, n_ring: int, scale: float,
+                causal: bool, window: int):
+    """Ring pass whose per-chunk attention is the pallas flash kernel.
+
+    Each visiting chunk contributes (o_c, lse_c); outputs combine exactly via
+    log-sum-exp weights. Chunks entirely in the future (src > idx under
+    causality) cost nothing: every k block fails the kernel's offset-aware
+    liveness test. Gradients flow through the combine into dlse, which the
+    kernel backward folds into its delta term."""
+    from trlx_tpu.ops.flash_attention import flash_attention, pick_block
+
+    b, t, h, d = q.shape
+    blk = pick_block(t)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
+
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t), M_INIT, jnp.float32)
+
+    def attend(k_c, v_c, mask_c, i, o, lse):
+        src = (idx - i) % n_ring
+        offset = ((src - idx) * t).astype(jnp.float32)
+        o_c, lse_c = flash_attention(
+            q, k_c, v_c, mask_c, scale=scale, causal=causal, window=window,
+            offset=offset, return_lse=True, block_q=blk, block_k=blk,
+        )
+        lse_new = jnp.logaddexp(lse, lse_c)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lse_c - lse_new).transpose(0, 2, 1)[..., None]
+        return o * w_old + o_c.astype(jnp.float32) * w_new, lse_new
+
+    def step(carry, i):
+        k_c, v_c, mask_c, o, lse = carry
+        o, lse = attend(k_c, v_c, mask_c, i, o, lse)
+        k_nxt = jax.lax.ppermute(k_c, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_c, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_c, axis_name, perm)
+        return (k_nxt, v_nxt, mask_nxt, o, lse), None
+
+    carry = (k, v, kv_mask, o0, lse0)
+    if n_ring > 1:
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(n_ring - 1))
+    k_c, v_c, mask_c, o, lse = carry
+    o, _ = attend(k_c, v_c, mask_c, jnp.asarray(n_ring - 1), o, lse)
+    return o.astype(q.dtype)
+
+
 def ring_attention_sharded(q, k, v, kv_mask, *, scale: float, causal: bool = True,
-                           window: int = 0, mesh=None):
+                           window: int = 0, mesh=None, use_flash=None):
     """jit-composable entry: shard_map over the full (dp, fsdp, tp, sp) mesh.
 
     q/k/v: GLOBAL [b, T, h, d] logical arrays (XLA reshards at the shard_map
@@ -115,7 +178,7 @@ def ring_attention_sharded(q, k, v, kv_mask, *, scale: float, causal: bool = Tru
     mask_spec = P(DATA_AXES, AXIS_SP)
     body = partial(
         ring_attention, axis_name=AXIS_SP, n_ring=n_ring, scale=scale,
-        causal=causal, window=window,
+        causal=causal, window=window, use_flash=use_flash,
     )
     return shard_map(
         lambda q, k, v, m: body(q, k, v, m),
