@@ -118,8 +118,8 @@ def test_auto_routing_thresholds(monkeypatch):
     with pytest.raises(ValueError):
         flash_eligible(LMConfig(attn_impl="pallas"), 512, has_cache=False)
 
-    from trlx_tpu.models.lm import _flash_block
+    from trlx_tpu.ops.flash_attention import pick_block
 
-    assert _flash_block(2048) == 512
-    assert _flash_block(768) == 256
-    assert _flash_block(48) == 48
+    assert pick_block(2048) == 512
+    assert pick_block(768) == 256
+    assert pick_block(48) == 48

@@ -145,12 +145,6 @@ def apply_rotary(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray, rotary_dim:
 # ---------------------------------------------------------------------------
 
 
-def _flash_block(q_len: int) -> int:
-    from trlx_tpu.ops.flash_attention import pick_block
-
-    return pick_block(q_len)
-
-
 def ring_eligible(cfg: LMConfig, q_len: int, has_cache: bool, batch: Optional[int] = None) -> bool:
     """Sequence-parallel ring attention applies to full-sequence passes when
     the model was built for an sp>1 mesh and the (static) shapes divide the
@@ -248,9 +242,9 @@ class Attention(nn.Module):
                     q, k, v, flash_mask, scale=scale, causal=True, window=window
                 ).astype(dtype)
             else:
-                from trlx_tpu.ops.flash_attention import flash_attention
+                from trlx_tpu.ops.flash_attention import flash_attention, pick_block
 
-                blk = _flash_block(q_len)
+                blk = pick_block(q_len)
                 out = flash_attention(
                     q, k, v, flash_mask, scale=scale, causal=True, window=window,
                     block_q=blk, block_k=blk,

@@ -405,6 +405,9 @@ def flash_attention(
         raise ValueError(f"seq len {T} not divisible by blocks ({bq}, {bk})")
     if interpret is None:
         interpret = _interpret_default()
+    # float32 deliberately: `off` is a differentiable custom_vjp operand
+    # (int32 would need float0 cotangent plumbing) and chunk displacements
+    # are exact in float32 far beyond any real sequence length (2^24).
     off = jnp.asarray(0.0 if offset is None else offset, jnp.float32).reshape(1, 1)
 
     def to_bh(x):

@@ -67,6 +67,27 @@ def generate(
         return jnp.concatenate([jnp.ones((B, n_soft), dtype=m.dtype), m], axis=1)
 
     cache = init_cache(cfg, B, T + n_soft)
+    # Pin the decode KV cache's layout: batch over the data axes, heads over
+    # tp — at 6B+ scale the cache dominates decode memory and XLA's
+    # propagation must not replicate it. Skipped when the shapes don't
+    # divide the mesh (tiny test models) or no mesh was ever created. NOTE:
+    # the mesh is read at trace time — trainers build one jitted generate fn
+    # per mesh setup, so a set_mesh() after tracing does not retro-apply.
+    from trlx_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.peek_mesh()
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+        data = int(mesh.shape[mesh_mod.AXIS_DP]) * int(mesh.shape[mesh_mod.AXIS_FSDP])
+        tp = int(mesh.shape[mesh_mod.AXIS_TP])
+        if B % data == 0 and cfg.n_head % tp == 0:
+            kv_sharding = NamedSharding(
+                mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP, None)
+            )
+            cache = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, kv_sharding), cache
+            )
     out = model.apply(
         variables,
         input_ids=prompt_ids,

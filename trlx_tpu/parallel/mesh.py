@@ -110,6 +110,12 @@ def set_mesh(mesh: Mesh):
     _GLOBAL_MESH = mesh
 
 
+def peek_mesh() -> Optional[Mesh]:
+    """The process-global mesh if one was created, else None (no side
+    effects — unlike get_mesh, which creates a default mesh)."""
+    return _GLOBAL_MESH
+
+
 def get_mesh(shape: Sequence[int] = (-1, 1, 1, 1)) -> Mesh:
     """Return the process-global mesh, creating it on first use."""
     global _GLOBAL_MESH
