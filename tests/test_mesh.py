@@ -91,7 +91,7 @@ def test_sharded_generation_matches_single_device():
     from trlx_tpu.models import LMWithValueHead
     from trlx_tpu.ops.generate import make_generate_fn
     from trlx_tpu.ops.sampling import GenerateConfig
-    from trlx_tpu.parallel.mesh import set_mesh
+    from trlx_tpu.parallel.mesh import peek_mesh, set_mesh
     from trlx_tpu.parallel.sharding import batch_sharding
 
     cfg = LMConfig(vocab_size=32, n_layer=2, n_head=4, d_model=64, max_position=64, dtype="float32")
@@ -105,6 +105,7 @@ def test_sharded_generation_matches_single_device():
 
     ref_toks, _ = gen({"params": params}, ids, mask, jax.random.PRNGKey(1))
 
+    prior = peek_mesh()
     mesh = make_mesh((1, 2, 4, 1))
     set_mesh(mesh)
     try:
@@ -113,5 +114,5 @@ def test_sharded_generation_matches_single_device():
         s_mask = jax.device_put(mask, batch_sharding(mesh, extra_dims=1))
         toks, _ = gen({"params": sharded_params}, s_ids, s_mask, jax.random.PRNGKey(1))
     finally:
-        set_mesh(make_mesh((-1, 1, 1, 1)))
+        set_mesh(prior)  # restore the exact prior global (possibly None)
     np.testing.assert_array_equal(np.asarray(ref_toks), np.asarray(toks))

@@ -88,6 +88,15 @@ def generate(
             cache = jax.tree_util.tree_map(
                 lambda x: jax.lax.with_sharding_constraint(x, kv_sharding), cache
             )
+        elif mesh.size > 1:
+            import warnings
+
+            warnings.warn(
+                f"decode KV cache left to XLA propagation: batch {B} or "
+                f"n_head {cfg.n_head} does not divide the mesh "
+                f"(data={data}, tp={tp}) — at large scale this can "
+                "replicate the cache per device"
+            )
     out = model.apply(
         variables,
         input_ids=prompt_ids,

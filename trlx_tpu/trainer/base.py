@@ -135,6 +135,17 @@ class JaxBaseTrainer(BaseRLTrainer):
         override for other parameter-efficiency schemes (soft prompts)."""
         return trainable_mask(init_params, self.model.cfg, self.config.model.num_layers_unfrozen)
 
+    def detach_frozen(self, params):
+        """stop_gradient on frozen leaves inside the loss: XLA then drops the
+        frozen blocks' weight-gradient matmuls entirely (≈half the backward
+        FLOPs per frozen layer). Activation gradients still flow through, so
+        trainable embeddings below frozen blocks keep learning. The optimizer
+        masking (build_trainable_mask) stays as the semantic source of truth;
+        this is the compute-side twin."""
+        return jax.tree_util.tree_map(
+            lambda p, t: p if t else jax.lax.stop_gradient(p), params, self.opt_mask
+        )
+
     def init_state(self, init_params) -> TrainState:
         """Build the initial TrainState (subclasses add extras)."""
         return TrainState(

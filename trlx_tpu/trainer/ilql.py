@@ -167,6 +167,7 @@ class ILQLTrainer(JaxBaseTrainer):
         schedule = self.schedule
 
         def loss_fn(params, extras, batch: ILQLBatch):
+            params = self.detach_frozen(params)
             out = model.apply(
                 {"params": params},
                 batch.input_ids,

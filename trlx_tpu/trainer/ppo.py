@@ -192,6 +192,7 @@ class PPOTrainer(JaxBaseTrainer):
         P = self.prompt_length
 
         def loss_fn(params, batch: PPORLBatch):
+            params = self.detach_frozen(params)
             all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
             all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
             out = model.apply({"params": params}, all_ids, all_mask, logits_start=P - 1)
