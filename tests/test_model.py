@@ -149,3 +149,29 @@ def test_trainable_mask_freezes_bottom_layers():
     assert mask["v_head"]["layers_0"]["kernel"] is True
     # embeddings stay trainable like the reference
     assert mask["transformer"]["wte"]["embedding"] is True
+
+
+def test_remat_grads_match():
+    """cfg.remat=True (nn.remat over blocks — the memory/FLOPs trade for 6B+
+    training) must not change gradients."""
+    import jax
+    from jax.flatten_util import ravel_pytree
+    import numpy as np
+
+    from trlx_tpu.models import TransformerLM
+
+    base = dict(vocab_size=31, n_layer=2, n_head=2, d_model=32, max_position=32, dtype="float32")
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 31, (2, 10)))
+    mask = jnp.ones((2, 10), jnp.int32)
+
+    plain = TransformerLM(LMConfig(**base))
+    remat = TransformerLM(LMConfig(**base, remat=True))
+    params = plain.init(jax.random.PRNGKey(0), ids, mask)["params"]
+
+    def loss(model):
+        return lambda p: jnp.sum(jnp.tanh(model.apply({"params": p}, ids, mask)["logits"].astype(jnp.float32)))
+
+    g1, _ = ravel_pytree(jax.grad(loss(plain))(params))
+    g2, _ = ravel_pytree(jax.jit(jax.grad(loss(remat)))(params))
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), atol=1e-4)

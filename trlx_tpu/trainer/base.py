@@ -63,6 +63,13 @@ class JaxBaseTrainer(BaseRLTrainer):
         state = self.init_state(init_params)
         self.state, self.state_shardings = shard_pytree(state, self.mesh)
 
+        # Resume BEFORE any rollout: PPO's initial experience must come from
+        # the restored policy, not the fresh init (stale behavior logprobs
+        # would mis-clip the whole first epoch's importance ratios).
+        self._resumed = False
+        if config.train.resume_from_checkpoint:
+            self._maybe_resume()
+
         run_name = config.model.model_path or "from-scratch"
         self.tracker = Tracker(
             project_name=config.train.project_name,
@@ -157,6 +164,27 @@ class JaxBaseTrainer(BaseRLTrainer):
 
     def make_extras(self, init_params):
         return None
+
+    def _maybe_resume(self):
+        """Restore the latest checkpoint if one exists. The existence check
+        is process-AGREED (main process decides, broadcast to all) so the
+        collective orbax restore is entered by every host or by none."""
+        latest = os.path.join(
+            os.path.abspath(self.config.train.checkpoint_dir), "latest.txt"
+        )
+        exists = os.path.exists(latest)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            exists = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(exists))
+            )
+        if not exists:
+            return
+        self.load()
+        self._resumed = True
+        if is_main_process():
+            print(f"[trlx_tpu] resumed from step {int(jax.device_get(self.state.step))}")
 
     # -------------------------------------------------------------- tokenize
 
@@ -290,7 +318,12 @@ class JaxBaseTrainer(BaseRLTrainer):
         intervals and the PPO rollout/optimize alternation via
         post_epoch_callback."""
         self.prepare_learning()
-        self.iter_count = 0
+        # True resume (the reference's checkpoints were save-only,
+        # reference: trlx/model/__init__.py:101-129): the state was restored
+        # in __init__ (before the first rollout); continue counting from it.
+        self.iter_count = int(jax.device_get(self.state.step)) if self._resumed else 0
+        if self.iter_count >= self.total_steps:
+            return self.evaluate()  # nothing left to train
 
         # jax.profiler trace of a few steady-state steps (reference has
         # wall-clock timers only, SURVEY.md §5; XLA traces are the TPU-native
