@@ -1,0 +1,15 @@
+# Dev targets (reference: Makefile style/quality; upgraded to ruff).
+.PHONY: test quality style bench
+
+test:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -m pytest tests/ -q
+
+quality:
+	ruff check trlx_tpu/ tests/ examples/ bench.py
+
+style:
+	ruff format trlx_tpu/ tests/ examples/ bench.py
+
+bench:
+	python bench.py

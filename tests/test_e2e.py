@@ -210,3 +210,32 @@ def test_resume_from_checkpoint_continues_training(task, tmp_path):
     # picked up at step 2 and trained only the remaining 3 steps
     assert int(jax.device_get(second.state.step)) == 5
     assert second.iter_count == 5
+
+
+def test_resume_restores_host_state(task, tmp_path):
+    """The adaptive KL coefficient and the sampling RNG are host-side Python
+    state; a true resume must restore them too."""
+    import jax
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    def run(total_steps, resume):
+        config = shrink(base_config("ppo", 15, 8))
+        config.train.total_steps = total_steps
+        config.train.checkpoint_dir = str(tmp_path / "ck")
+        config.train.resume_from_checkpoint = resume
+        return trlx_tpu.train(
+            reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+            metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+        )
+
+    first = run(total_steps=2, resume=False)
+    first.kl_ctl.value = 0.0123  # pretend the controller adapted
+    first.save()
+
+    second = run(total_steps=4, resume=True)
+    # restored at construction time, then possibly adapted during the 2
+    # resumed steps — but never reset to init_kl_coef (0.05 in this config)
+    assert second.kl_ctl.value != first.config.method.init_kl_coef
+    assert second.kl_ctl.value == pytest.approx(0.0123, rel=0.2)

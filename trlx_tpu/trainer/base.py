@@ -427,33 +427,60 @@ class JaxBaseTrainer(BaseRLTrainer):
 
     # ------------------------------------------------------------ checkpoint
 
+    def host_state_dict(self) -> dict:
+        """Host-side Python state that a true resume must also restore
+        (subclasses extend — PPO adds the adaptive KL coefficient)."""
+        return {"rng": [int(x) for x in np.asarray(jax.device_get(self.rng)).reshape(-1)]}
+
+    def load_host_state(self, d: dict):
+        """Called during __init__-time resume — subclass state that doesn't
+        exist yet is re-applied from self.loaded_host_state afterwards."""
+        self.loaded_host_state = d
+        if "rng" in d:
+            self.rng = jnp.asarray(np.asarray(d["rng"], dtype=np.uint32))
+
     def save(self, directory: Optional[str] = None):
         """Orbax sharded checkpoint of the FULL TrainState (params, optimizer
-        moments, step, extras) — a true resume point, unlike the reference's
-        save-only accelerator.save_state
+        moments, step, extras) plus host-side state (RNG, KL controller) — a
+        true resume point, unlike the reference's save-only
+        accelerator.save_state
         (reference: trlx/model/accelerate_base_model.py:126-128)."""
+        import json
+
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
-        path = os.path.join(directory, f"state_{int(jax.device_get(self.state.step))}")
+        name = f"state_{int(jax.device_get(self.state.step))}"
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path, self.state, force=True)
+        ckptr.save(os.path.join(directory, name), self.state, force=True)
         ckptr.wait_until_finished()
         if is_main_process():
+            with open(os.path.join(directory, f"{name}.host.json"), "w") as f:
+                json.dump(self.host_state_dict(), f)
+            # basename, not abspath: checkpoint dirs get synced/remounted
+            # between the preempted VM and its replacement.
             with open(os.path.join(directory, "latest.txt"), "w") as f:
-                f.write(path)
+                f.write(name)
 
     def load(self, directory: Optional[str] = None):
-        """Restore a TrainState saved by `save` (resume support the reference
-        lacks)."""
+        """Restore a TrainState + host state saved by `save` (resume support
+        the reference lacks)."""
+        import json
+
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
-        latest = os.path.join(directory, "latest.txt")
-        with open(latest) as f:
-            path = f.read().strip()
+        with open(os.path.join(directory, "latest.txt")) as f:
+            name = f.read().strip()
+        # Older checkpoints stored an absolute path; fall back to its
+        # basename under the current directory when it moved.
+        path = name if os.path.isabs(name) and os.path.exists(name) else os.path.join(directory, os.path.basename(name))
         ckptr = ocp.StandardCheckpointer()
         self.state = ckptr.restore(path, self.state)
+        host_file = f"{path}.host.json"
+        if os.path.exists(host_file):
+            with open(host_file) as f:
+                self.load_host_state(json.load(f))
         return self.state
 
     # ------------------------------------------------------- BaseRL protocol

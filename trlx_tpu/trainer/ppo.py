@@ -68,6 +68,10 @@ class PPOTrainer(JaxBaseTrainer):
             self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
         else:
             self.kl_ctl = FixedKLController(m.init_kl_coef)
+        # Resume happened in the base __init__, before kl_ctl existed.
+        resumed = getattr(self, "loaded_host_state", None)
+        if resumed and "kl_coef" in resumed:
+            self.kl_ctl.value = float(resumed["kl_coef"])
 
         # Static decode shapes: prompt length + new tokens == seq_length.
         gen_kwargs = dict(m.gen_kwargs)
@@ -226,6 +230,16 @@ class PPOTrainer(JaxBaseTrainer):
             return new_state, stats
 
         return jax.jit(train_step, donate_argnums=(0,))
+
+    def host_state_dict(self) -> dict:
+        d = super().host_state_dict()
+        d["kl_coef"] = float(self.kl_ctl.value)
+        return d
+
+    def load_host_state(self, d: dict):
+        super().load_host_state(d)
+        if "kl_coef" in d and hasattr(self, "kl_ctl"):
+            self.kl_ctl.value = float(d["kl_coef"])
 
     # ------------------------------------------------------------- callbacks
 
