@@ -215,8 +215,6 @@ def test_resume_from_checkpoint_continues_training(task, tmp_path):
 def test_resume_restores_host_state(task, tmp_path):
     """The adaptive KL coefficient and the sampling RNG are host-side Python
     state; a true resume must restore them too."""
-    import jax
-
     walks, logit_mask, metric_fn, reward_fn = task
     prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
 

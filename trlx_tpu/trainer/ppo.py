@@ -68,10 +68,11 @@ class PPOTrainer(JaxBaseTrainer):
             self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
         else:
             self.kl_ctl = FixedKLController(m.init_kl_coef)
-        # Resume happened in the base __init__, before kl_ctl existed.
+        # Resume happened in the base __init__, before kl_ctl existed —
+        # re-apply the buffered host state now that it does.
         resumed = getattr(self, "loaded_host_state", None)
-        if resumed and "kl_coef" in resumed:
-            self.kl_ctl.value = float(resumed["kl_coef"])
+        if resumed:
+            self.load_host_state(resumed)
 
         # Static decode shapes: prompt length + new tokens == seq_length.
         gen_kwargs = dict(m.gen_kwargs)
