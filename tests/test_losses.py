@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trlx_tpu.ops.modeling import masked_mean, masked_whiten, logprobs_from_logits, topk_mask
+from trlx_tpu.ops.modeling import masked_whiten, logprobs_from_logits, topk_mask
 from trlx_tpu.ops.rl_losses import gae_advantages, kl_penalty_rewards, ppo_loss
 from trlx_tpu.ops.ilql_loss import ilql_loss
 

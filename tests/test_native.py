@@ -69,7 +69,6 @@ def test_rollout_buffer_fallback(monkeypatch):
 
 
 def test_ppo_storage_roundtrip():
-    from trlx_tpu.data import PPORLElement
     from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 
     store = PPORolloutStorage(pad_token_id=0)

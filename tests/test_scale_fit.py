@@ -4,7 +4,6 @@ allocation, runs on CPU — validating the sharding math BASELINE.md's
 targets depend on (the reference can only discover OOM by crashing)."""
 
 import numpy as np
-import pytest
 
 import jax
 from jax.sharding import PartitionSpec as P

@@ -52,7 +52,7 @@ def test_soft_prompt_generate_cache_consistency():
 
 
 def test_softprompt_trainable_mask():
-    import trlx_tpu.trainer.api  # registries
+    import trlx_tpu.trainer.api  # noqa: F401  (populates registries)
     from trlx_tpu.trainer import get_model
 
     cls = get_model("ppo_softprompt")

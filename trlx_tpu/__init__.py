@@ -14,7 +14,7 @@ Public API mirrors the reference's single entry point
     trlx_tpu.train("gpt2", dataset=(samples, rs))  # offline ILQL
 """
 
-from trlx_tpu.trlx import train
+from trlx_tpu.trlx import train  # noqa: F401  (public API re-export)
 
 __version__ = "0.1.0"
 

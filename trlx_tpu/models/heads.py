@@ -11,7 +11,7 @@ policy and the ref "branch" are the SAME module; the branch is just a second
 pjit both applies fuse into one XLA program.
 """
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax

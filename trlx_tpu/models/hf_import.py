@@ -9,9 +9,8 @@ the TPU program never touches torch. Supported families match the reference's
 (reference: examples/randomwalks.py:99-101).
 """
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

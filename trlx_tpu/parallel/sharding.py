@@ -22,7 +22,7 @@ from typing import Any, List, Sequence, Tuple
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from trlx_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
+from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_SP, AXIS_TP, DATA_AXES
 
 
 def lm_partition_rules() -> List[Tuple[str, P]]:

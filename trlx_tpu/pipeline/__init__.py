@@ -9,7 +9,7 @@ report the valid count.
 """
 
 from abc import abstractmethod
-from typing import Any, Callable, Dict, Iterable, List, Optional
+from typing import Any, Callable, Dict, Iterable, List
 
 import numpy as np
 

@@ -7,7 +7,7 @@ shape, left-padded (the decode engine samples at the last position), so the
 whole rollout path compiles exactly once.
 """
 
-from typing import Iterable, List, Optional
+from typing import Iterable
 
 import numpy as np
 

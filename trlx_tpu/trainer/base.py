@@ -28,7 +28,7 @@ from trlx_tpu.models.heads import trainable_mask
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
 from trlx_tpu.parallel.mesh import DATA_AXES, barrier, init_distributed, is_main_process
 from trlx_tpu.trainer import BaseRLTrainer
-from trlx_tpu.utils import Clock, significant
+from trlx_tpu.utils import Clock
 from trlx_tpu.utils.logging import Tracker
 
 

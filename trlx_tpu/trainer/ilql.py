@@ -14,8 +14,6 @@ CausalLMWithValueHeads' target-head machinery
   (reference: trlx/model/nn/ilql_models.py:162-251).
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -129,7 +127,9 @@ class ILQLTrainer(JaxBaseTrainer):
         # rank-gated jitted forward would deadlock an SPMD pod. ILQL generate
         # runs only from evaluate() (offline method — no online rollouts), so
         # the extra stats forward is off the training path.
-        if jax.process_count() == 1 and "debug" not in __import__("os").environ:
+        import os
+
+        if jax.process_count() == 1 and "debug" not in os.environ:
             self._log_decode_stats(params, tokens, mask)
         return tokens, mask
 

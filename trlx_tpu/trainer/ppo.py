@@ -9,7 +9,6 @@ controller stays host-side Python, exactly as stateful-scalar logic should.
 """
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +18,6 @@ import optax
 from trlx_tpu.data import PPORLBatch
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import LMWithValueHead, extract_branch_params
-from trlx_tpu.models.lm import LMConfig
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.modeling import logprobs_from_logits
 from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss

@@ -4,7 +4,7 @@ Filled in as trainer/orchestrator/pipeline layers land; the dispatch contract
 is identical to the reference: reward_fn → online PPO, dataset → offline ILQL.
 """
 
-from typing import Callable, Iterable, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from trlx_tpu.data.configs import TRLConfig
 
