@@ -4,7 +4,7 @@ The reference builds models with AutoModelForCausalLM.from_pretrained
 (reference: trlx/model/nn/ppo_models.py:322-325). Here HF is only a WEIGHT
 SOURCE: torch state dicts are converted once, on host, into our Flax layout;
 the TPU program never touches torch. Supported families match the reference's
-(reference: README.md:6): gpt2, gpt-j, gpt-neox. With no checkpoint (or
+(reference: README.md:6): gpt2, gpt-j, gpt-neo, gpt-neox. With no checkpoint (or
 `model_arch` given) params initialize from scratch — the randomwalks path
 (reference: examples/randomwalks.py:99-101).
 """
