@@ -237,3 +237,19 @@ def test_resume_restores_host_state(task, tmp_path):
     # resumed steps — but never reset to init_kl_coef (0.05 in this config)
     assert second.kl_ctl.value != first.config.method.init_kl_coef
     assert second.kl_ctl.value == pytest.approx(0.0123, rel=0.2)
+
+
+def test_offline_orchestrator_degenerate_samples(task):
+    """Prompt-only / over-truncated samples must not crash experience
+    building (empty action rows are padded no-ops in the storage)."""
+    from trlx_tpu.orchestrator.offline_orchestrator import OfflineOrchestrator
+    from trlx_tpu.trainer.ilql import ILQLTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ilql", 15, 8))
+    config.train.total_steps = 1
+    model = ILQLTrainer(config, metric_fn=metric_fn, logit_mask=logit_mask)
+    orch = OfflineOrchestrator(model)
+    samples = [np.asarray([3]), np.asarray(walks[0]), np.asarray(walks[1])]
+    orch.make_experience(samples, [0.5, 1.0, -1.0])
+    assert len(model.store) == 3

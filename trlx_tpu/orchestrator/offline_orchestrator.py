@@ -37,32 +37,54 @@ class OfflineOrchestrator(Orchestrator):
             else:
                 prompt_tok_len = 1
             L = min(len(s_tok), T)
-            a_ixs = np.arange(prompt_tok_len - 1, L - 1)
-            s_ixs = np.arange(prompt_tok_len - 1, L)
+            # Samples whose prompt consumes the whole (possibly truncated)
+            # sequence have no continuation tokens: empty action row, which
+            # the zero-padded storage + terminal masking handle as a no-op.
+            # (start clamps to >= 0 so an empty sample yields empty rows, not
+            # a -1 index.)
+            start = max(0, min(prompt_tok_len - 1, L - 1))
+            a_ixs = np.arange(start, L - 1)
+            s_ixs = np.arange(start, L)
             terminals = np.ones_like(s_ixs)
-            terminals[-1] = 0
+            if len(terminals):
+                terminals[-1] = 0
             actions_ixs.append(a_ixs)
             states_ixs.append(s_ixs)
             dones.append(terminals)
 
         if model.tokenizer is not None:
-            prompt = model.tokenizer.decode(input_ids[0][: states_ixs[0][1]])
-            response = model.tokenizer.decode(input_ids[0][states_ixs[0][1] :])
-            print("[Sample example]")
-            print("Prompt: ", prompt)
-            print("Response: ", response)
+            # first sample that actually has a continuation
+            for i, s_ix in enumerate(states_ixs):
+                if len(s_ix) > 1:
+                    print("[Sample example]")
+                    print("Prompt: ", model.tokenizer.decode(input_ids[i][: s_ix[1]]))
+                    print("Response: ", model.tokenizer.decode(input_ids[i][s_ix[1] :]))
+                    break
 
         sample_lengths = np.asarray([len(x) for x in input_ids], dtype=np.float32)
         print(f"[Mean reward] {np.mean(np.asarray(rewards, dtype=np.float32)):.2f}")
         print(f"[Mean sample length] {np.mean(sample_lengths):.2f}")
 
-        # z-score returns; terminal reward on the final action
+        # z-score returns over the samples that actually train (degenerate
+        # prompt-only rows would pollute the statistics while contributing
+        # nothing); terminal reward on the final action
         # (reference: trlx/orchestrator/offline_orchestrator.py:63-68)
         returns = np.asarray(rewards, dtype=np.float32)
-        returns = (returns - returns.mean()) / (returns.std() + 1e-30)
+        valid = np.asarray([len(a) > 0 for a in actions_ixs])
+        if not valid.all():
+            import warnings
+
+            warnings.warn(
+                f"{int((~valid).sum())}/{len(valid)} offline samples have no "
+                "continuation tokens (prompt-only or over-truncated) — they "
+                "are stored as no-ops and excluded from return normalization"
+            )
+        base = returns[valid] if valid.any() else returns
+        returns = (returns - base.mean()) / (base.std() + 1e-30)
         reward_rows = [np.zeros(len(a), dtype=np.float32) for a in actions_ixs]
         for rs, G in zip(reward_rows, returns):
-            rs[-1] = G
+            if len(rs):
+                rs[-1] = G
 
         attention_mask = [np.ones(min(len(x), T), dtype=np.int32) for x in input_ids]
 
