@@ -1,16 +1,28 @@
-"""Benchmark: PPO iteration throughput (samples/sec/chip) on real hardware.
+"""Benchmark: PPO iteration throughput + MFU on real hardware.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Measures the full PPO cadence — compiled rollout generation (prefill +
-while_loop decode), fused rollout scoring, and ppo_epochs donated train steps
-— on a GPT-J-family model sized to the chip (BENCH_PRESET env: tiny|small|
-medium|long; long runs seq-1024 through the pallas flash path). The reference publishes no numbers (BASELINE.md); the recorded
-Accelerate-GPU comparison baseline is 1.0 samples/sec/chip until a measured
-reference lands, so vs_baseline == value.
+while_loop decode), fused rollout scoring, and ppo_epochs donated train
+steps — and reports, alongside samples/s/chip:
+
+- per-phase wall time (generate / score / train),
+- modeled TFLOP/s and %-of-peak (MFU) for the train step and for the whole
+  iteration, against the detected chip's peak bf16 FLOP/s,
+- the honest model identity (a GPT-J-family architecture auto-sized to the
+  chip's HBM — "gptj-l28-d4096" IS 6B; smaller chips bench a smaller
+  truthfully-named proxy).
+
+The default preset is "auto": the largest HBM-fitting entry from SIZES at
+seq 1024 (768-token prefill + 256-token decode), which routes scoring and
+training attention through the pallas flash kernel. The reference publishes
+no numbers (BASELINE.md); the recorded Accelerate-GPU comparison baseline is
+1.0 samples/sec/chip until a measured reference lands, so vs_baseline ==
+value.
 """
 
+import gc
 import json
 import os
 import sys
@@ -18,26 +30,105 @@ import time
 
 import numpy as np
 
-
+# (name, n_layer, d_model, n_head, vocab, prompt, new_tokens, batch, unfrozen)
+# Auto sizes run with bf16 params (master + moments) — throughput benching,
+# named honestly in the metric. A 16GB v5e fits the 3.7B entry; fp32-master
+# production recipes shard over fsdp instead (ppo_gptj_config.yml).
+SIZES = [
+    ("gptj-l28-d4096-6.1B-bf16", 28, 4096, 16, 50400, 768, 256, 8, 2),
+    ("gptj-l16-d4096-3.7B-bf16", 16, 4096, 16, 50400, 768, 256, 8, 2),
+    ("gptj-l8-d4096-2.0B-bf16", 8, 4096, 16, 50400, 768, 256, 8, 2),
+    ("gptj-l4-d4096-1.2B-bf16", 4, 4096, 16, 50400, 768, 256, 4, 2),
+    ("gptj-l4-d2048-0.4B-bf16", 4, 2048, 16, 50400, 768, 256, 4, 2),
+    ("gptj-l2-d512-tiny", 2, 512, 8, 1024, 256, 128, 4, 1),
+]
+# Legacy fixed presets (BENCH_PRESET env) — the r1 shapes, kept comparable.
 PRESETS = {
-    # name: (n_layer, d_model, n_head, vocab, prompt_len, new_tokens, batch)
-    "tiny": (2, 256, 8, 1024, 16, 32, 16),
-    "small": (8, 1024, 16, 50400, 16, 32, 16),
-    "medium": (16, 2048, 16, 50400, 16, 32, 8),
-    # long-context: seq 1024 routes scoring/training attention through the
-    # pallas flash kernel (and the sp ring when run on an sp>1 mesh)
-    "long": (8, 1024, 16, 50400, 768, 256, 4),
+    "tiny": ("gptj-l2-d256", 2, 256, 8, 1024, 16, 32, 16, 1),
+    "small": ("gptj-l8-d1024", 8, 1024, 16, 50400, 16, 32, 16, 4),
+    "medium": ("gptj-l16-d2048", 16, 2048, 16, 50400, 16, 32, 8, 8),
+    "long": ("gptj-l8-d1024", 8, 1024, 16, 50400, 768, 256, 4, 4),
 }
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring.
+PEAK_TFLOPS = [
+    ("v6", 918.0),  # trillium
+    ("v5p", 459.0),
+    ("v5e", 197.0),  # v5 litepod
+    ("v5", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),  # bf16
+    ("v2", 45.0),
+]
+
+
+def detect_peak_tflops():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_TFLOPS:
+        if key in kind:
+            return peak, kind
+    return None, kind
+
+
+# HBM per chip by device_kind substring, for environments (like the tunneled
+# axon chip) where memory_stats() is unavailable.
+HBM_BYTES = [
+    ("v5 lite", 16e9),
+    ("v5e", 16e9),
+    ("v5p", 95e9),
+    ("v6", 32e9),
+    ("v4", 32e9),
+    ("v3", 32e9),
+    ("v2", 16e9),
+]
+
+
+def hbm_bytes():
+    import jax
+
+    dev = jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    kind = dev.device_kind.lower()
+    for key, hbm in HBM_BYTES:
+        if key in kind:
+            return int(hbm)
+    return None
+
+
+def fits_hbm(L, d, vocab, unfrozen, hbm, param_bytes=2):
+    """Rough static-memory model: master params + Adam moments on trainable
+    params (top `unfrozen` blocks + embeddings + heads) + frozen ref branch
+    copy, all at `param_bytes` per element, with a 1.6x activation/workspace
+    margin. Conservative on purpose — the auto-sizer also try/excepts OOM."""
+    block = 12 * d * d
+    emb = 2 * vocab * d  # wte + untied lm_head
+    params = L * block + emb
+    trainable = unfrozen * block + emb + 3 * 2 * d * d  # + value head approx
+    branch = unfrozen * block + emb  # frozen ref branch copy (hydra extras)
+    bytes_needed = (params + trainable * 2 + branch) * param_bytes
+    return bytes_needed * 1.6 < hbm
+
+
+def lm_flops(L, d, vocab, n_tokens, kv_avg, logits_tokens, value_head=False):
+    """Modeled fwd matmul FLOPs: per LAYER 12·d² MACs/token in blocks
+    (qkv+proj+mlp) + 2·kv·d MACs/token attention; plus d·vocab MACs per
+    logits token and (value_head) 4·d² MACs/token; ×2 FLOP/MAC."""
+    per_tok = L * (12 * d * d + 2 * kv_avg * d)
+    if value_head:
+        per_tok += 4 * d * d  # MLPHead d -> 2d -> 1
+    return 2.0 * (n_tokens * per_tok + logits_tokens * d * vocab)
 
 
 def main():
-    preset = os.environ.get("BENCH_PRESET", "small")
-    n_layer, d_model, n_head, vocab, P, R, B = PRESETS[preset]
-
     import jax
 
-    # Persistent XLA compilation cache: repeated bench runs (the driver runs
-    # this every round) skip the 20-40s first-compile cost.
     cache_dir = os.environ.get("BENCH_COMPILE_CACHE", os.path.expanduser("~/.cache/trlx_tpu/xla"))
     if cache_dir:
         try:
@@ -47,24 +138,63 @@ def main():
         except Exception:
             pass
 
+    preset = os.environ.get("BENCH_PRESET", "auto")
+    if preset != "auto":
+        candidates = [PRESETS[preset]]
+    else:
+        hbm = hbm_bytes()
+        candidates = [
+            s for s in SIZES if hbm is None or fits_hbm(s[1], s[2], s[4], s[8], hbm)
+        ] or [SIZES[-1]]
+        if jax.default_backend() != "tpu":  # CPU dev runs: smallest only
+            candidates = [SIZES[-1]]
+
+    result = None
+    for cand in candidates:
+        try:
+            result = run_one(cand)
+            break
+        except Exception as e:  # OOM on an optimistic size → next smaller
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
+                raise
+            # Drop the traceback BEFORE collecting: its frames pin the failed
+            # trainer's device arrays, and a leaked attempt OOMs every
+            # subsequent (even tiny) size.
+            e.__traceback__ = None
+            del e
+            print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
+        gc.collect()
+    if result is None:
+        raise RuntimeError("no bench size fit the device")
+    print(json.dumps(result))
+
+
+def run_one(cand):
+    import jax
+
+    name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen = cand
+    # Tuning knobs (experimentation; the shipped SIZES carry the defaults).
+    B = int(os.environ.get("BENCH_BATCH", B))
+    remat_env = os.environ.get("BENCH_REMAT")
     from trlx_tpu.data import PPORLBatch
     from trlx_tpu.trainer.api import default_config
     from trlx_tpu.trainer.ppo import PPOTrainer
 
-    # Batch must shard evenly over the data-parallel axis on multi-chip hosts.
     n_dev = jax.device_count()
     B = ((B + n_dev - 1) // n_dev) * n_dev
+    T = P + R
 
     config = default_config("ppo")
     config.model.model_path = ""
     config.model.tokenizer_path = ""
-    config.model.num_layers_unfrozen = max(n_layer // 2, 1)
+    config.model.num_layers_unfrozen = unfrozen
     config.model.model_arch = {
         "vocab_size": vocab,
         "n_layer": n_layer,
         "n_head": n_head,
         "d_model": d_model,
-        "max_position": 2048,
+        "max_position": max(2048, T),
         "eos_token_id": 0,
         "pos_type": "rotary",
         "rotary_dim": 64 if d_model // n_head >= 64 else d_model // n_head,
@@ -75,8 +205,14 @@ def main():
         "tie_word_embeddings": False,
         "extra": {"lm_head_bias": True},
     }
+    config.model.remat = d_model >= 4096 if remat_env is None else remat_env == "1"
+    if name.endswith("-bf16"):
+        # Throughput benching at the largest HBM-fitting size: bf16 master
+        # params + moments (named honestly in the metric). Production fp32-
+        # master recipes shard over fsdp instead.
+        config.model.param_dtype = "bfloat16"
     config.train.batch_size = B
-    config.train.seq_length = P + R
+    config.train.seq_length = T
     config.train.mesh = [-1, 1, 1, 1]
     config.method.gen_kwargs = {
         "prompt_length": P,
@@ -91,16 +227,12 @@ def main():
     config.method.ppo_epochs = 4
 
     trainer = PPOTrainer(config)
-    n_chips = jax.device_count()
     rng = np.random.default_rng(0)
     prompt_ids = rng.integers(2, vocab, size=(B, P)).astype(np.int32)
     prompt_mask = np.ones((B, P), dtype=np.int32)
 
-    def ppo_iteration():
-        tokens, mask = trainer.rollout_generate(prompt_ids, prompt_mask)
-        scores = rng.normal(size=(B,)).astype(np.float32)
-        logprobs, values, rewards, _ = trainer.rollout_score(tokens, mask, scores)
-        batch = trainer.put_batch(
+    def make_batch(tokens, mask, logprobs, values, rewards):
+        return trainer.put_batch(
             PPORLBatch(
                 query_tensors=np.asarray(tokens[:, :P]),
                 response_tensors=np.asarray(tokens[:, P:]),
@@ -111,31 +243,99 @@ def main():
                 query_mask=np.asarray(mask[:, :P]),
             )
         )
+
+    def sync(tree):
+        """True device sync: host-read one scalar of the result. On the
+        tunneled axon backend block_until_ready does NOT actually block, so
+        a tiny transfer is the only reliable phase barrier (and the real PPO
+        cadence has exactly these host reads anyway)."""
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+    def phase_generate():
+        tokens, mask = trainer.rollout_generate(prompt_ids, prompt_mask)
+        sync(tokens)
+        return tokens, mask
+
+    def phase_score(tokens, mask):
+        scores = rng.normal(size=(B,)).astype(np.float32)
+        out = trainer.rollout_score(tokens, mask, scores)
+        sync(out[0])
+        return out
+
+    def phase_train(batch):
         for _ in range(config.method.ppo_epochs):
             trainer.state, stats = trainer.train_step(trainer.state, batch)
-        jax.block_until_ready(trainer.state.params)
+        sync(trainer.state.params)
 
-    # warmup / compile
-    ppo_iteration()
+    # Warmup / compile all three programs once.
+    tokens, mask = phase_generate()
+    logprobs, values, rewards, _ = phase_score(tokens, mask)
+    phase_train(make_batch(tokens, mask, logprobs, values, rewards))
 
     iters = int(os.environ.get("BENCH_ITERS", "3"))
+    t_gen = t_score = t_train = 0.0
     t0 = time.time()
     for _ in range(iters):
-        ppo_iteration()
+        t = time.time()
+        tokens, mask = phase_generate()
+        t_gen += time.time() - t
+        t = time.time()
+        logprobs, values, rewards, _ = phase_score(tokens, mask)
+        t_score += time.time() - t
+        t = time.time()
+        phase_train(make_batch(tokens, mask, logprobs, values, rewards))
+        t_train += time.time() - t
     elapsed = time.time() - t0
 
+    n_chips = jax.device_count()
     samples = iters * B
     sps_per_chip = samples / elapsed / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": f"ppo_samples_per_sec_per_chip[{preset},gptj-arch,l{n_layer},d{d_model},seq{P+R}]",
-                "value": round(sps_per_chip, 3),
-                "unit": "samples/s/chip",
-                "vs_baseline": round(sps_per_chip, 3),
-            }
-        )
+
+    # ---- modeled FLOPs (see lm_flops) -------------------------------------
+    L, d, V = n_layer, d_model, vocab
+    resp = T - P + 1  # logits region [P-1, T)
+    kv_train = T / 2  # causal average
+    fwd_train = lm_flops(L, d, V, B * T, kv_train, B * resp, value_head=True)
+    # bwd = activation-grad pass over everything + weight-grad pass over the
+    # trainable fraction (stop_gradient skips frozen weight grads).
+    f_train = (unfrozen * 12 * d * d + 2 * V * d) / (L * 12 * d * d + 2 * V * d)
+    train_step = fwd_train * (2.0 + f_train)
+    train_flops = config.method.ppo_epochs * train_step
+    # scoring: policy fwd + frozen branch replay over `unfrozen` layers
+    score_flops = lm_flops(L, d, V, B * T, kv_train, B * resp, value_head=True) + lm_flops(
+        unfrozen, d, V, B * T, kv_train, B * resp
     )
+    # generation: prefill + R single-token decode steps (kv grows P..T)
+    gen_flops = lm_flops(L, d, V, B * P, P / 2, B) + lm_flops(
+        L, d, V, B * R, (P + T) / 2, B * R
+    )
+    iter_flops = gen_flops + score_flops + train_flops
+
+    peak, kind = detect_peak_tflops()
+    train_tflops = train_flops * iters / max(t_train, 1e-9) / n_chips / 1e12
+    iter_tflops = iter_flops * iters / max(elapsed, 1e-9) / n_chips / 1e12
+
+    out = {
+        "metric": f"ppo_samples_per_sec_per_chip[{name},seq{T},prefill{P}+decode{R},b{B}]",
+        "value": round(sps_per_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps_per_chip, 3),
+        "device_kind": kind,
+        "n_chips": n_chips,
+        "phase_seconds_per_iter": {
+            "generate": round(t_gen / iters, 3),
+            "score": round(t_score / iters, 3),
+            "train": round(t_train / iters, 3),
+        },
+        "train_tflops_per_chip": round(train_tflops, 2),
+        "iter_tflops_per_chip": round(iter_tflops, 2),
+    }
+    if peak:
+        out["peak_bf16_tflops"] = peak
+        out["train_mfu_pct"] = round(100 * train_tflops / peak, 2)
+        out["iter_mfu_pct"] = round(100 * iter_tflops / peak, 2)
+    return out
 
 
 if __name__ == "__main__":

@@ -96,6 +96,57 @@ def test_model_flash_matches_xla_path():
     np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_x), atol=5e-4)
 
 
+def test_prefill_flash_matches_xla_cache_path():
+    """Generation prefill (cache present, write offset 0) through the flash
+    kernel must reproduce the einsum-over-cache path bit-for-nearly-bit:
+    logits AND the written KV cache."""
+    from trlx_tpu.models.lm import init_cache
+
+    base = dict(
+        vocab_size=97,
+        n_layer=2,
+        n_head=2,
+        d_model=32,
+        max_position=512,
+        pos_type="rotary",
+        rotary_dim=8,
+        dtype="float32",
+    )
+    rng = np.random.default_rng(3)
+    B, P, N = 2, 128, 32
+    ids = jnp.asarray(rng.integers(0, 97, (B, P)))
+    mask = jnp.ones((B, P), jnp.int32).at[0, :13].set(0)  # left padding
+
+    xla_model = TransformerLM(LMConfig(**base, attn_impl="xla"))
+    flash_model = TransformerLM(LMConfig(**base, attn_impl="flash"))
+    params = xla_model.init(jax.random.PRNGKey(0), ids, mask)["params"]
+
+    def prefill(model):
+        cfg = model.cfg
+        cache = init_cache(cfg, B, P + N)
+        cache_mask = jnp.concatenate([mask, jnp.zeros((B, N), jnp.int32)], axis=1)
+        return model.apply(
+            {"params": params}, ids, mask, cache=cache, cache_index=0, cache_mask=cache_mask
+        )
+
+    ox = prefill(xla_model)
+    of = prefill(flash_model)
+    fmask = mask[:, :, None].astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(of["logits"] * fmask), np.asarray(ox["logits"] * fmask), atol=2e-4
+    )
+    # The cache writes are identical at every VALID slot regardless of the
+    # attention engine. (Pad-slot k/v in layers > 0 differ: each engine emits
+    # a different — equally meaningless — attention mix for fully-masked pad
+    # query rows, which feeds the next layer's projections there. Those slots
+    # have cache_mask 0 and are never read by decode.)
+    cmask = np.zeros((B, P + N, 1, 1), np.float32)
+    cmask[:, :P] = np.asarray(mask, np.float32)[:, :, None, None]
+    for (kf, vf), (kx, vx) in zip(of["cache"], ox["cache"]):
+        np.testing.assert_allclose(np.asarray(kf) * cmask, np.asarray(kx) * cmask, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(vf) * cmask, np.asarray(vx) * cmask, atol=1e-5)
+
+
 def test_auto_routing_thresholds(monkeypatch):
     from trlx_tpu.models import lm as lm_mod
     from trlx_tpu.models.lm import flash_eligible
@@ -110,7 +161,11 @@ def test_auto_routing_thresholds(monkeypatch):
     assert not flash_eligible(auto, 64, has_cache=False)  # short RLHF seqs
     assert flash_eligible(auto, 512, has_cache=False)
     assert flash_eligible(auto, 768, has_cache=False)  # 128-aligned, non-512
-    assert not flash_eligible(auto, 512, has_cache=True)  # decode
+    assert not flash_eligible(auto, 512, has_cache=True)  # mid-decode replay
+    assert not flash_eligible(auto, 1, has_cache=True, prefill_at_zero=False)  # decode step
+    # generation prefill at write offset 0: eligible when long + aligned
+    assert flash_eligible(auto, 512, has_cache=True, prefill_at_zero=True)
+    assert not flash_eligible(auto, 64, has_cache=True, prefill_at_zero=True)
     assert not flash_eligible(auto, 300, has_cache=False)  # unaligned
     forced = LMConfig(attn_impl="flash")
     assert flash_eligible(forced, 48, has_cache=False)

@@ -109,10 +109,16 @@ def test_sharded_generation_matches_single_device():
     mesh = make_mesh((1, 2, 4, 1))
     set_mesh(mesh)
     try:
+        # A generate fn is bound to the mesh it was built under: calling the
+        # old one after set_mesh must fail LOUDLY (stale KV-cache placement),
+        # and a freshly built one must work.
+        with np.testing.assert_raises(RuntimeError):
+            gen({"params": params}, ids, mask, jax.random.PRNGKey(1))
+        gen_sharded = make_generate_fn(model, gcfg)
         sharded_params, _ = shard_pytree(params, mesh)
         s_ids = jax.device_put(ids, batch_sharding(mesh, extra_dims=1))
         s_mask = jax.device_put(mask, batch_sharding(mesh, extra_dims=1))
-        toks, _ = gen({"params": sharded_params}, s_ids, s_mask, jax.random.PRNGKey(1))
+        toks, _ = gen_sharded({"params": sharded_params}, s_ids, s_mask, jax.random.PRNGKey(1))
     finally:
         set_mesh(prior)  # restore the exact prior global (possibly None)
     np.testing.assert_array_equal(np.asarray(ref_toks), np.asarray(toks))

@@ -1,0 +1,97 @@
+"""BatchLoader valid-count, pad_ragged edge cases, truncation conventions."""
+
+import numpy as np
+
+from trlx_tpu.native import pad_ragged
+from trlx_tpu.pipeline import BatchLoader
+
+
+def test_batchloader_reports_valid_count():
+    """14 items at batch 16 → one batch padded by wrap-around, n_valid == 14."""
+    data = np.arange(14) * 10
+
+    def collate(ixs):
+        return data[ixs]
+
+    loader = BatchLoader(14, 16, collate, shuffle=False, drop_last=False)
+    batches = list(loader.iter_with_valid())
+    assert len(batches) == 1
+    batch, n_valid = batches[0]
+    assert n_valid == 14
+    assert batch.shape == (16,)
+    # rows [n_valid:] are wrap-around duplicates of the head of the order
+    assert batch[14] == data[0] and batch[15] == data[1]
+
+
+def test_batchloader_valid_count_multiple_batches():
+    data = np.arange(20)
+
+    def collate(ixs):
+        return data[ixs]
+
+    loader = BatchLoader(20, 8, collate, shuffle=False, drop_last=False)
+    batches = list(loader.iter_with_valid())
+    assert [nv for _, nv in batches] == [8, 8, 4]
+    assert all(b.shape == (8,) for b, _ in batches)
+    # plain iteration drops the counts but yields identical batches
+    assert all(
+        np.array_equal(a, b)
+        for a, (b, _) in zip(loader, BatchLoader(20, 8, collate, drop_last=False).iter_with_valid())
+    )
+
+
+def test_batchloader_drop_last_has_no_partial_batches():
+    loader = BatchLoader(14, 16, lambda ixs: ixs, shuffle=False, drop_last=True)
+    assert list(loader) == []
+
+
+def test_pad_ragged_normalizes_non_1d_rows():
+    """Rows arriving as [n, 1] column vectors (or nested lists) must not
+    corrupt the flat-buffer offsets in the native path."""
+    rows = [np.arange(3).reshape(3, 1), np.arange(5).reshape(1, 5), [[7], [8]]]
+    ids, mask = pad_ragged(rows, max_len=6, pad_id=-1, left_pad=False, keep_last=False)
+    np.testing.assert_array_equal(ids[0], [0, 1, 2, -1, -1, -1])
+    np.testing.assert_array_equal(ids[1], [0, 1, 2, 3, 4, -1])
+    np.testing.assert_array_equal(ids[2], [7, 8, -1, -1, -1, -1])
+    np.testing.assert_array_equal(mask.sum(1), [3, 5, 2])
+
+
+class CharTokenizer:
+    """Minimal tokenizer stand-in: one token per character (no downloads)."""
+
+    bos_token_id = 1
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [ord(c) % 256 for c in text]}
+
+    def batch_decode(self, tokens, skip_special_tokens=True):
+        return ["".join(chr(int(t)) for t in row if t > 1) for row in tokens]
+
+
+def test_tokenize_truncation_keeps_trailing_tokens():
+    """Framework-wide prompt rule: overlong prompts keep the TRAILING tokens
+    (the most recent context), matching PromptPipeline's keep_last."""
+    from trlx_tpu.trainer.base import JaxBaseTrainer
+
+    class Host:
+        tokenizer = CharTokenizer()
+
+        class config:
+            class train:
+                seq_length = 4
+
+    text = "abcdefgh"
+    ids = JaxBaseTrainer.tokenize(Host(), [text])[0]
+    expected_tail = [ord(c) for c in "efgh"]
+    assert list(ids) == expected_tail  # BOS itself truncated away: tail wins
+
+
+def test_prompt_pipeline_truncates_keeping_tail():
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    pipe = PromptPipeline(["abcdefgh"], tokenizer=CharTokenizer(), max_prompt_length=4)
+    row = pipe[0]
+    assert list(row["input_ids"]) == [ord(c) for c in "efgh"]
+    assert list(row["attention_mask"]) == [1, 1, 1, 1]

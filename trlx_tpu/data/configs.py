@@ -35,6 +35,13 @@ class ModelConfig:
     :param dtype: compute dtype ("bfloat16" on TPU; MXU-native).
     :param param_dtype: parameter storage dtype ("float32" master params).
     :param remat: rematerialize transformer blocks (trade FLOPs for HBM).
+    :param reward_model_path / reward_model_arch: an ON-DEVICE learned reward
+        model (LM + scalar head, scored at the last valid token) sharded with
+        the same partition rules as the policy and evaluated inside the fused
+        rollout-scoring program. Replaces the host `reward_fn` boundary — the
+        only way to express a pod-scale RM (e.g. BASELINE.json's NeoX-20B PPO
+        w/ learned RM; the reference can only call host Python on decoded
+        text, reference: trlx/orchestrator/ppo_orchestrator.py:73).
     """
 
     model_path: str
@@ -45,6 +52,12 @@ class ModelConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
+    reward_model_path: str = ""
+    reward_model_arch: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def has_reward_model(self) -> bool:
+        return bool(self.reward_model_path or self.reward_model_arch)
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

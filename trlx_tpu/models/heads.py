@@ -73,6 +73,7 @@ class LMWithValueHead(nn.Module):
         collect_branch_hidden: bool = False,
         prepend_soft: bool = True,
         logits_start: int = 0,
+        compute_logits: bool = True,
     ):
         out = self.transformer(
             input_ids=input_ids,
@@ -85,6 +86,7 @@ class LMWithValueHead(nn.Module):
             collect_hidden_at=self.branch_layer if (collect_branch_hidden and self.branch_layer >= 0) else None,
             prepend_soft=prepend_soft,
             logits_start=logits_start,
+            compute_logits=compute_logits,
         )
         values = self.v_head(out["hidden"])[..., 0]
         return {

@@ -161,18 +161,26 @@ def ring_eligible(cfg: LMConfig, q_len: int, has_cache: bool, batch: Optional[in
     return cfg.n_head % int(mesh.shape[AXIS_TP]) == 0
 
 
-def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool) -> bool:
+def flash_eligible(cfg: LMConfig, q_len: int, has_cache: bool, prefill_at_zero: bool = False) -> bool:
     """Static routing decision between the pallas flash kernel and XLA einsum.
 
-    Flash only applies to full-sequence (no-KV-cache) passes; decode steps
-    have q_len==1 and stay on einsum. "auto" reserves flash for long aligned
-    sequences where the O(T^2) bias materialization actually hurts.
+    Flash applies to full-sequence (no-KV-cache) passes AND to generation
+    prefill (cache present, q_len > 1, write offset 0): during prefill every
+    cache slot beyond the prompt block is still invalid, so attention over
+    just the local [q_len] block is exact — the kernel sees ordinary
+    self-attention while K/V are written to the cache on the side. This keeps
+    the hottest long-context path (a 768+-token prefill) off the einsum
+    engine's materialized [b,1,P,T] bias. Single-token decode steps (q_len==1)
+    stay on einsum. "auto" reserves flash for long aligned sequences where the
+    O(T^2) bias materialization actually hurts.
     """
     if cfg.attn_impl not in ("auto", "flash", "xla"):
         raise ValueError(f"attn_impl must be auto|flash|xla, got {cfg.attn_impl!r}")
     from trlx_tpu.ops.flash_attention import _HAVE_PLTPU
 
-    if has_cache or cfg.attn_impl == "xla" or not _HAVE_PLTPU:
+    if cfg.attn_impl == "xla" or not _HAVE_PLTPU:
+        return False
+    if has_cache and not (q_len > 1 and prefill_at_zero):
         return False
     if cfg.attn_impl == "auto":
         from trlx_tpu.ops.flash_attention import auto_flash_ok
@@ -230,8 +238,13 @@ class Attention(nn.Module):
             k_cache, v_cache = cache
             k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
-            k, v = k_cache, v_cache
             new_cache = (k_cache, v_cache)
+            # Flash prefill attends over the LOCAL block only (cache slots
+            # beyond the prompt are invalid until decode) — k/v stay local.
+            # The einsum paths (decode steps, unaligned prefill) attend over
+            # the cache buffers with the cache-validity bias.
+            if flash_mask is None:
+                k, v = k_cache, v_cache
 
         scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
         if flash_mask is not None:
@@ -419,7 +432,14 @@ class TransformerLM(nn.Module):
             x = x + wpe
 
         use_ring = ring_eligible(cfg, q_len, cache is not None, b)
-        use_flash = use_ring or flash_eligible(cfg, q_len, cache is not None)
+        # Prefill at a STATIC zero write offset may use flash over the local
+        # block (see flash_eligible); decode steps pass a traced cache_index.
+        prefill_at_zero = (
+            cache is not None
+            and isinstance(cache_index, (int, np.integer))
+            and int(cache_index) == 0
+        )
+        use_flash = use_ring or flash_eligible(cfg, q_len, cache is not None, prefill_at_zero)
         if use_flash:
             attn_bias = local_bias = None
             flash_mask = attention_mask.astype(jnp.float32)
@@ -437,7 +457,11 @@ class TransformerLM(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, prevent_cse=False)
+            # window/use_ring are Python control-flow values inside the block
+            # (`if use_ring:`) — they must stay STATIC under remat tracing or
+            # TracerBoolConversionError fires on the flash/ring paths.
+            # Argnums count self as 0: x=1 ... window=7, use_ring=8.
+            block_cls = nn.remat(Block, prevent_cse=False, static_argnums=(7, 8))
 
         branch_hidden = None
         new_cache = [] if cache is not None else None

@@ -11,6 +11,7 @@ import hashlib
 import os
 import subprocess
 import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -95,13 +96,17 @@ def pad_ragged(
     lib = _build_and_load()
     out_ids = np.empty((n, max_len), dtype=np.int32)
     out_mask = np.empty((n, max_len), dtype=np.int32)
+    # Normalize rows to flat int32 FIRST and derive lengths from the
+    # normalized arrays: len(t) on a non-1-D row would disagree with its
+    # flattened element count and corrupt every following row boundary.
+    rows = [np.asarray(t, dtype=np.int32).reshape(-1) for t in token_lists]
     if lib is not None:
-        lengths = np.fromiter((len(t) for t in token_lists), dtype=np.int64, count=n)
+        lengths = np.fromiter((len(r) for r in rows), dtype=np.int64, count=n)
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(lengths, out=offsets[1:])
         flat = np.empty(int(offsets[-1]), dtype=np.int32)
-        for i, t in enumerate(token_lists):
-            flat[offsets[i] : offsets[i + 1]] = np.asarray(t, dtype=np.int32).reshape(-1)
+        for i, r in enumerate(rows):
+            flat[offsets[i] : offsets[i + 1]] = r
         lib.pad_ragged_i32(
             _as_i32p(flat), _as_i64p(offsets), n, max_len, pad_id,
             int(left_pad), int(keep_last), _as_i32p(out_ids), _as_i32p(out_mask),
@@ -110,9 +115,8 @@ def pad_ragged(
 
     out_ids.fill(pad_id)
     out_mask.fill(0)
-    for i, t in enumerate(token_lists):
-        row = np.asarray(t, dtype=np.int32).reshape(-1)
-        row = row[-max_len:] if keep_last else row[:max_len]
+    for i, r in enumerate(rows):
+        row = r[-max_len:] if keep_last else r[:max_len]
         L = len(row)
         sl = slice(max_len - L, max_len) if left_pad else slice(0, L)
         out_ids[i, sl] = row
@@ -137,6 +141,11 @@ class RolloutBuffer:
         if self._lib is not None:
             elems = np.asarray([e for _, e, _ in self.fields], dtype=np.int64)
             self._h = ctypes.c_void_p(self._lib.rb_new(len(self.fields), _as_i64p(elems)))
+            # weakref.finalize, not __del__: at interpreter shutdown the
+            # ctypes lib/module globals may already be torn down, so a __del__
+            # free could raise (ignored) or be skipped entirely. finalize runs
+            # at GC time or atexit, while its captured refs are still alive.
+            self._finalizer = weakref.finalize(self, _free_rb, self._lib, self._h)
         else:
             self._chunks: Dict[str, List[np.ndarray]] = {n: [] for n, _, _ in self.fields}
             self._consolidated: Optional[Dict[str, np.ndarray]] = None
@@ -207,8 +216,9 @@ class RolloutBuffer:
             out[name] = self._consolidated[name][ixs]
         return out
 
-    def __del__(self):
-        lib = getattr(self, "_lib", None)
-        h = getattr(self, "_h", None)
-        if lib is not None and h:
-            lib.rb_free(h)
+def _free_rb(lib, h):
+    """Module-level finalizer target (must not reference the buffer object)."""
+    try:
+        lib.rb_free(h)
+    except Exception:
+        pass

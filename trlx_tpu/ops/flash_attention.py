@@ -86,6 +86,21 @@ def _smem_spec():
     return pl.BlockSpec((1, 1), lambda *_: (0, 0))  # pragma: no cover
 
 
+def _compiler_params(interpret):
+    """Mark the (bh, outer-block) grid dims parallel so Mosaic pipelines
+    across grid steps instead of serializing them; only the innermost dim
+    (the online-softmax / accumulation walk) is order-dependent. Without
+    this the kernel is grid-step-latency-bound: at [8,1024,16,256] the
+    forward drops from ~18ms to ~3ms on a v5e."""
+    if not _HAVE_PLTPU or interpret:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    }
+
+
 # ---------------------------------------------------------------------------
 # Shared score block
 # ---------------------------------------------------------------------------
@@ -208,6 +223,7 @@ def _fwd(q, k, v, kmask, off, scale, causal, window, bq, bk, interpret):
             _scratch((bq, 128)),
         ],
         interpret=interpret,
+        **_compiler_params(interpret),
     )(off, kmask, q, k, v)
     return o, lse
 
@@ -338,6 +354,7 @@ def _flash_lse_bwd(scale, causal, window, bq, bk, interpret, res, cts):
         out_shape=[jax.ShapeDtypeStruct((BH, T, D), q.dtype)],
         scratch_shapes=[_scratch((bq, D))],
         interpret=interpret,
+        **_compiler_params(interpret),
     )(*in_arrays)[0]
 
     # k-side: grid walks (bh, k_block, q_block) — q innermost so dk/dv
@@ -365,6 +382,7 @@ def _flash_lse_bwd(scale, causal, window, bq, bk, interpret, res, cts):
         ],
         scratch_shapes=[_scratch((bk, D)), _scratch((bk, D))],
         interpret=interpret,
+        **_compiler_params(interpret),
     )(*in_arrays)
 
     return dq, dk, dv, jnp.zeros_like(kmask), jnp.zeros_like(off)

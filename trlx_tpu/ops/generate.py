@@ -36,7 +36,8 @@ def generate(
     gcfg: GenerateConfig,
     processor: Optional[Callable] = None,
     carry_keys: Tuple[str, ...] = (),
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    step_stats_fn: Optional[Callable] = None,
+) -> Tuple[jnp.ndarray, ...]:
     """Decode `gcfg.max_new_tokens` tokens after left-padded prompts.
 
     prompt_ids/prompt_mask: [b, P] (left-padded). Returns (tokens, mask) of
@@ -47,7 +48,13 @@ def generate(
     last-position values are carried through the loop and handed to the
     processor under state["carry"] — this is how advantage-steered decoding
     reads the Q/V heads each step.
-    """
+
+    `step_stats_fn(tok, state) -> {name: [b] float}` (optional) reduces the
+    in-loop state to per-step scalars — e.g. Q(s, tok) and V(s) from the
+    carry — which are collected into [b, max_new_tokens] float32 buffers and
+    returned as a third output. This makes decode diagnostics free: no extra
+    forward pass after generation (validity = the returned mask's response
+    region). When set, the return is (tokens, mask, stats)."""
     cfg = model.cfg
     B, P = prompt_ids.shape
     N = gcfg.max_new_tokens
@@ -71,8 +78,9 @@ def generate(
     # tp — at 6B+ scale the cache dominates decode memory and XLA's
     # propagation must not replicate it. Skipped when the shapes don't
     # divide the mesh (tiny test models) or no mesh was ever created. NOTE:
-    # the mesh is read at trace time — trainers build one jitted generate fn
-    # per mesh setup, so a set_mesh() after tracing does not retro-apply.
+    # the mesh is read at trace time; make_generate_fn asserts at every call
+    # that the process mesh still matches, so a set_mesh() after tracing
+    # fails loudly instead of silently misplacing the cache.
     from trlx_tpu.parallel import mesh as mesh_mod
 
     mesh = mesh_mod.peek_mesh()
@@ -120,6 +128,12 @@ def generate(
         "last_hidden": out["hidden"][:, -1],
         "carry": {k: last_pos(out[k]) for k in carry_keys},
     }
+    if step_stats_fn is not None:
+        # eval_shape: discover the stat names without executing the fn.
+        probe = jax.eval_shape(
+            step_stats_fn, jax.ShapeDtypeStruct((B,), tokens.dtype), state
+        )
+        state["stats"] = {k: jnp.zeros((B, N), dtype=jnp.float32) for k in probe}
 
     def cond(s):
         return (s["step"] < N) & ~jnp.all(s["finished"])
@@ -160,7 +174,7 @@ def generate(
             cache_mask=with_soft(mask),
             prepend_soft=False,
         )
-        return {
+        new_s = {
             "tokens": tokens,
             "mask": mask,
             "cache": step_out["cache"],
@@ -171,16 +185,56 @@ def generate(
             "last_hidden": step_out["hidden"][:, 0],
             "carry": {k: last_pos(step_out[k]) for k in carry_keys},
         }
+        if step_stats_fn is not None:
+            # Stats read the PRE-step state: Q/V at the position that
+            # produced `tok` (state-before-token, matching rollout scoring).
+            sv = step_stats_fn(tok, s)
+            new_s["stats"] = {
+                k: jax.lax.dynamic_update_slice(
+                    s["stats"][k], sv[k].astype(jnp.float32)[:, None], (0, step)
+                )
+                for k in s["stats"]
+            }
+        return new_s
 
     final = jax.lax.while_loop(cond, body, state)
+    if step_stats_fn is not None:
+        return final["tokens"], final["mask"], final["stats"]
     return final["tokens"], final["mask"]
 
 
-def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = ()):
+def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = (), step_stats_fn: Optional[Callable] = None):
     """Build a jitted generate fn of (variables, prompt_ids, prompt_mask, rng).
 
     Call once per (model, gcfg, processor) and reuse — each distinct
-    (batch, prompt_len) shape compiles once, then is cached.
+    (batch, prompt_len) shape compiles once, then is cached. The KV-cache
+    sharding constraint reads the process-global mesh at trace time, so the
+    built fn is bound to the mesh active at build time: calling it after a
+    set_mesh() swap raises instead of silently tracing/running with a stale
+    cache placement.
     """
-    fn = partial(generate, model=model, gcfg=gcfg, processor=processor, carry_keys=carry_keys)
-    return jax.jit(fn)
+    from trlx_tpu.parallel import mesh as mesh_mod
+
+    built_mesh = mesh_mod.peek_mesh()
+    fn = partial(
+        generate,
+        model=model,
+        gcfg=gcfg,
+        processor=processor,
+        carry_keys=carry_keys,
+        step_stats_fn=step_stats_fn,
+    )
+    jitted = jax.jit(fn)
+
+    def call(variables, prompt_ids, prompt_mask, rng):
+        current = mesh_mod.peek_mesh()
+        if current is not built_mesh:
+            raise RuntimeError(
+                "generate fn was built under a different process mesh than is "
+                "now active (set_mesh() after make_generate_fn). Rebuild the "
+                "generate fn for the new mesh — the traced KV-cache sharding "
+                "would otherwise be stale."
+            )
+        return jitted(variables, prompt_ids, prompt_mask, rng)
+
+    return call

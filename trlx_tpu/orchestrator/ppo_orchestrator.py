@@ -19,6 +19,7 @@ loader can prefetch (device work is enqueued, not awaited, until arrays are
 read) — the reference serializes these phases.
 """
 
+import jax
 import numpy as np
 
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
@@ -72,29 +73,54 @@ class PPOOrchestrator(Orchestrator):
         pending = self._generate_next_chunk()
         while True:
             tokens, mask, P = pending
-            chunk_rows = int(tokens.shape[0])  # static shape — no device sync
+            # Rows THIS process will store (num_rollouts is per-process, the
+            # reference's per-rank semantics). Static shape — no device sync.
+            chunk_rows = int(tokens.shape[0]) // jax.process_count()
             need_more = n_collected + chunk_rows < num_rollouts
             if need_more:
                 pending = self._generate_next_chunk()
 
-            # Host boundary: decode → user reward_fn (overlaps the pending
-            # generation running on device).
-            texts_or_tokens = self.rl_model.decode(tokens, mask)
-            scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+            # ONE device→host pull of the generation grids per chunk — both
+            # reward paths and the store push reuse these host rows.
+            tokens_h, mask_h = self.rl_model.to_local_host((tokens, mask))
 
-            # Device: score rollouts (logprobs/values/ref-KL rewards fused).
-            logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
+            if getattr(self.rl_model, "has_reward_model", False):
+                # On-device learned RM: the whole scoring pass (policy
+                # logprobs/values, hydra ref KL, RM scores) is ONE fused
+                # sharded program — no decode, no host reward boundary.
+                logprobs, values, rewards, kl, scores = self.rl_model.rollout_score_rm(
+                    tokens, mask
+                )
+                scores = self.rl_model.to_local_host(scores)
+            else:
+                # Host boundary: decode → user reward_fn. Process-LOCAL on
+                # every host: these are this process's rows only, reward_fn
+                # scores them, and rollout_score's put_batch reassembles the
+                # global scores array — so a multi-host pod never
+                # materializes non-addressable shards on any single host
+                # (the reference's per-rank reward_fn semantics,
+                # reference: trlx/orchestrator/ppo_orchestrator.py:73).
+                # Overlaps the pending generation running on device.
+                texts_or_tokens = self.rl_model.decode(tokens_h, mask_h)
+                scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
 
-            tokens, mask = np.asarray(tokens), np.asarray(mask)
+                # Device: score rollouts (logprobs/values/ref-KL rewards fused).
+                logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
+
+            # Store holds process-local rows; put_batch re-shards them on the
+            # way back to the device at train time.
+            logprobs, values, rewards, kl = self.rl_model.to_local_host(
+                (logprobs, values, rewards, kl)
+            )
             self.rl_model.store.push_batch(
                 {
-                    "query_tensors": tokens[:, :P],
-                    "query_mask": mask[:, :P],
-                    "response_tensors": tokens[:, P:],
-                    "response_mask": mask[:, P:],
-                    "logprobs": np.asarray(logprobs),
-                    "values": np.asarray(values),
-                    "rewards": np.asarray(rewards),
+                    "query_tensors": tokens_h[:, :P],
+                    "query_mask": mask_h[:, :P],
+                    "response_tensors": tokens_h[:, P:],
+                    "response_mask": mask_h[:, P:],
+                    "logprobs": logprobs,
+                    "values": values,
+                    "rewards": rewards,
                 }
             )
             n_collected += chunk_rows
@@ -102,4 +128,12 @@ class PPOOrchestrator(Orchestrator):
                 break
 
         exp_time = clock.tick()
-        self.rl_model.tracker.log({"exp_time": exp_time, "rollout_mean_score": float(np.mean(scores)), "rollout_mean_kl": float(np.mean(np.asarray(kl).sum(-1)))}, step=iter_count)
+        # Process-local statistics of the final chunk (logging only).
+        self.rl_model.tracker.log(
+            {
+                "exp_time": exp_time,
+                "rollout_mean_score": float(np.mean(scores)),
+                "rollout_mean_kl": float(np.mean(kl.sum(-1))),
+            },
+            step=iter_count,
+        )

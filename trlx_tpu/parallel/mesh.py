@@ -124,6 +124,51 @@ def get_mesh(shape: Sequence[int] = (-1, 1, 1, 1)) -> Mesh:
     return _GLOBAL_MESH
 
 
+def to_local_host(tree, mesh: Optional[Mesh] = None, batch_axes=DATA_AXES):
+    """Global (possibly multi-host sharded) device arrays → THIS process's
+    batch rows as host numpy.
+
+    The device→host inverse of the put_batch direction
+    (host_local_array_to_global_array): each process gets back exactly the
+    rows it fed in, so rollout decode/score/store stay process-local and the
+    whole path is process-count-agnostic. A plain np.asarray on a multi-host
+    global array would throw on non-addressable shards. Single-process (and
+    for host numpy passed through): a plain np.asarray.
+    """
+
+    def pull(x):
+        if jax.process_count() == 1 or not isinstance(x, jax.Array):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec(batch_axes, *([None] * (x.ndim - 1)))
+        m = mesh if mesh is not None else get_mesh()
+        return np.asarray(
+            multihost_utils.global_array_to_host_local_array(x, m, spec)
+        )
+
+    return jax.tree_util.tree_map(pull, tree)
+
+
+def allgather_host(tree):
+    """Each process's host-local numpy rows → the full global rows on every
+    process, concatenated along axis 0 in process order.
+
+    The counterpart of the reference's eval-time accelerator.gather
+    (reference: trlx/model/accelerate_base_model.py:149-158). Single-process:
+    identity (np.asarray).
+    """
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True)),
+        tree,
+    )
+
+
 def barrier():
     """Cross-host barrier ≈ the reference's torch.distributed.barrier
     (reference: trlx/model/accelerate_base_model.py:33-34). A tiny psum forces

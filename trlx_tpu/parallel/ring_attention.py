@@ -77,24 +77,50 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str, n_ring: int, scale: floa
 
     def attend(k_c, v_c, mask_c, i, m, l, acc):
         src = (idx - i) % n_ring  # which chunk is visiting this step
-        k_pos = src * t + jnp.arange(t)
 
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32)) * scale
-        pair = mask_c[:, None, None, :] > 0
-        kp = k_pos[None, None, None, :]
-        qp = q_pos[None, None, :, None]
+        def live(_):
+            k_pos = src * t + jnp.arange(t)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c.astype(jnp.float32)) * scale
+            pair = mask_c[:, None, None, :] > 0
+            kp = k_pos[None, None, None, :]
+            qp = q_pos[None, None, :, None]
+            if causal:
+                pair = pair & (kp <= qp)
+            if window > 0:
+                pair = pair & (kp > qp - window)
+            s = jnp.where(pair, s, MASK_VAL)
+
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32))
+            return m_new, l_new, acc_new
+
+        def dead(_):
+            return m, l, acc
+
+        # Skip chunks the mask would zero out ENTIRELY — the einsum twin of
+        # the flash engine's per-block liveness test: a causal pass never pays
+        # for fully-future chunks (src > idx), a windowed pass never pays for
+        # chunks wholly older than the window. The ppermute rotation still
+        # runs every step (the ring must keep turning); only the O(t²·d)
+        # einsum work is skipped. Residual imbalance under causality is
+        # inherent to contiguous chunk layout: rank r does r+1 live chunks,
+        # so the last rank does ~2× the mean — a zig-zag (chunk i and
+        # 2n−1−i per device) layout would even it, at the cost of
+        # non-contiguous sequence sharding everywhere else in the model.
+        dead_conds = []
         if causal:
-            pair = pair & (kp <= qp)
+            dead_conds.append(src > idx)
         if window > 0:
-            pair = pair & (kp > qp - window)
-        s = jnp.where(pair, s, MASK_VAL)
-
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, v_c.astype(jnp.float32))
-        return m_new, l_new, acc_new
+            dead_conds.append(src * t + t - 1 <= idx * t - window)
+        if not dead_conds:
+            return live(None)
+        is_dead = dead_conds[0]
+        for c in dead_conds[1:]:
+            is_dead = is_dead | c
+        return jax.lax.cond(is_dead, dead, live, None)
 
     def step(carry, i):
         k_c, v_c, mask_c, m, l, acc = carry

@@ -62,16 +62,25 @@ class BatchLoader:
         return (self.n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self):
+        for batch, _ in self.iter_with_valid():
+            yield batch
+
+    def iter_with_valid(self):
+        """Yield (batch, n_valid). n_valid < batch_size only on a wrapped
+        final batch (drop_last=False); rows [n_valid:] are wrap-around
+        duplicates, present purely to keep the batch shape static — consumers
+        computing statistics (eval means, sample tables) must drop them."""
         order = np.arange(self.n)
         if self.shuffle:
             self._rng.shuffle(order)
         nb = len(self)
         for b in range(nb):
             ix = order[b * self.batch_size : (b + 1) * self.batch_size]
-            if len(ix) < self.batch_size:  # wrap-around pad to static shape
-                reps = int(np.ceil((self.batch_size - len(ix)) / self.n))
+            n_valid = len(ix)
+            if n_valid < self.batch_size:  # wrap-around pad to static shape
+                reps = int(np.ceil((self.batch_size - n_valid) / self.n))
                 ix = np.concatenate([ix] + [order] * reps)[: self.batch_size]
-            yield self.collate(ix)
+            yield self.collate(ix), n_valid
 
 
 class BasePipeline:

@@ -41,8 +41,19 @@ def train(
     split_token: Optional[str] = None,
     logit_mask=None,
 ):
-    if reward_fn is not None:
-        # ---------------- online PPO (reference: trlx/trlx.py:38-59)
+    has_rm = config is not None and config.model.has_reward_model
+    if reward_fn is not None and has_rm:
+        raise ValueError(
+            "Both reward_fn and an on-device reward model "
+            "(model.reward_model_path/reward_model_arch) are set — rollouts "
+            "would optimize the RM while eval reports reward_fn. Pick one "
+            "reward source."
+        )
+    if reward_fn is not None or has_rm:
+        # ---------------- online PPO (reference: trlx/trlx.py:38-59).
+        # Dispatch extends the reference's: an ON-DEVICE reward model in the
+        # config selects PPO too (scores computed inside rollout scoring —
+        # no host reward_fn needed).
         if config is None:
             config = default_config("ppo")
         if model_path:

@@ -189,7 +189,13 @@ class JaxBaseTrainer(BaseRLTrainer):
     # -------------------------------------------------------------- tokenize
 
     def tokenize(self, texts):
-        """BOS + text, truncated to seq_length
+        """BOS + text, truncated to seq_length keeping the TRAILING tokens.
+
+        Truncation convention, unified framework-wide: PROMPTS keep the most
+        recent (trailing) context — the same keep_last rule as PromptPipeline
+        and the left-padding discipline. Offline ILQL SAMPLES are the one
+        deliberate exception (tokenize_ilql keeps leading tokens, so
+        action/state indices stay aligned from the sequence start).
         (reference: trlx/model/accelerate_base_model.py:93-103, minus its
         nonexistent-config-field bug)."""
         assert self.tokenizer is not None, "tokenize() requires a tokenizer"
@@ -198,17 +204,28 @@ class JaxBaseTrainer(BaseRLTrainer):
             ids = self.tokenizer(text, add_special_tokens=False)["input_ids"]
             if self.tokenizer.bos_token_id is not None:
                 ids = [self.tokenizer.bos_token_id] + ids
-            out.append(ids[: self.config.train.seq_length])
+            out.append(ids[-self.config.train.seq_length :])
         return out
 
+    def to_local_host(self, tree):
+        """Global device arrays → this process's batch rows as host numpy
+        (see parallel.mesh.to_local_host)."""
+        from trlx_tpu.parallel.mesh import to_local_host
+
+        return to_local_host(tree, mesh=self.mesh)
+
     def decode(self, tokens, mask=None):
-        """Device tokens → host text (or trimmed token arrays w/o tokenizer)."""
-        tokens = np.asarray(tokens)
+        """Device tokens → host text (or trimmed token arrays w/o tokenizer).
+
+        Multi-host: each process decodes ITS OWN batch rows (the device→host
+        pull goes through addressable shards only — np.asarray on a global
+        array would throw on a pod)."""
+        tokens = self.to_local_host(tokens)
         if self.tokenizer is not None:
             return self.tokenizer.batch_decode(tokens, skip_special_tokens=True)
         if mask is None:
             return [t for t in tokens]
-        mask = np.asarray(mask)
+        mask = self.to_local_host(mask)
         return [t[m.astype(bool)] for t, m in zip(tokens, mask)]
 
     def next_rng(self):
@@ -270,16 +287,54 @@ class JaxBaseTrainer(BaseRLTrainer):
     def add_eval_pipeline(self, eval_pipeline):
         self.eval_pipeline = eval_pipeline
 
+    def _gather_valid_rows(self, tree, n_valid: int):
+        """One eval batch of per-row arrays → host rows over exactly the
+        valid rows, from ALL processes.
+
+        Each process pulls its own rows, drops the loader's wrap-around
+        duplicates ([n_valid:]), then arrays (token grids, scores — not
+        strings, which can't ride collectives) are all-gathered so every
+        process returns the full global rows (reference's eval gather:
+        trlx/model/accelerate_base_model.py:149-158). n_valid is per-process:
+        each process's loader wraps independently."""
+        tree = self.to_local_host(tree)
+        tree = jax.tree_util.tree_map(lambda x: x[:n_valid], tree)
+        if jax.process_count() == 1:
+            return tree
+        from trlx_tpu.parallel.mesh import allgather_host
+
+        # Pad row counts to a common size before the fixed-shape gather,
+        # then trim each process's segment by its gathered valid count.
+        nv = allgather_host(np.asarray([n_valid], dtype=np.int32)).reshape(-1)
+        B = int(nv.max())
+
+        def g(x):
+            pad = [(0, B - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            xg = allgather_host(np.pad(x, pad)).reshape((len(nv), B) + x.shape[1:])
+            return np.concatenate([xg[p, : nv[p]] for p in range(len(nv))])
+
+        return jax.tree_util.tree_map(g, tree)
+
     def evaluate(self):
         """Sample eval prompts, score/metric, log a table
-        (reference: trlx/model/accelerate_base_model.py:134-201)."""
+        (reference: trlx/model/accelerate_base_model.py:134-201). Statistics
+        run over exactly the valid eval rows: the loader's static-shape
+        wrap-around duplicates are dropped before means/tables. With an
+        on-device reward model (and no host reward_fn), eval rewards come
+        from the RM."""
         stats = {}
-        all_texts, all_tokens = [], []
+        all_texts = []
+        rm_scores = []
+        use_rm = self.reward_fn is None and getattr(self, "has_reward_model", False)
         clock = Clock()
-        for batch in self.eval_dataloader:
+        for batch, n_valid in self.eval_dataloader.iter_with_valid():
             tokens, mask = self.rollout_generate(batch["input_ids"], batch["attention_mask"])
-            all_tokens.append((np.asarray(tokens), np.asarray(mask)))
-            all_texts.extend(self.decode(tokens, mask))
+            if use_rm:
+                rm_scores.append(
+                    self._gather_valid_rows(self.rm_eval_scores(tokens, mask), n_valid)
+                )
+            t, m = self._gather_valid_rows((tokens, mask), n_valid)
+            all_texts.extend(self.decode(t, m))
         stats["generate_time"] = clock.tick()
 
         if not is_main_process():
@@ -287,11 +342,15 @@ class JaxBaseTrainer(BaseRLTrainer):
 
         columns = ["sample"]
         rows = [[t] for t in all_texts]
-        if self.reward_fn is not None:
+        rewards = None
+        if use_rm:
+            rewards = np.concatenate(rm_scores).astype(np.float32)
+        elif self.reward_fn is not None:
             t0 = time.time()
             rewards = np.asarray(self.reward_fn(all_texts), dtype=np.float32)
-            stats["mean_reward"] = float(np.mean(rewards))
             stats["metric_time"] = time.time() - t0
+        if rewards is not None:
+            stats["mean_reward"] = float(np.mean(rewards))
             columns.append("reward")
             for row, r in zip(rows, rewards):
                 row.append(float(r))
@@ -327,17 +386,22 @@ class JaxBaseTrainer(BaseRLTrainer):
 
         # jax.profiler trace of a few steady-state steps (reference has
         # wall-clock timers only, SURVEY.md §5; XLA traces are the TPU-native
-        # upgrade). Steps [2, 5): past compilation, short enough to inspect.
+        # upgrade). The window is anchored to steps-since-learn-start, not the
+        # absolute iter_count — a resumed run (iter_count restored > 2) still
+        # profiles its own steps [2, 5): past this process's compilation,
+        # short enough to inspect.
         profile_dir = self.config.train.profile_dir
         self._profiling = False
+        learn_start = self.iter_count
 
         def profiler_tick():
             if not profile_dir or not is_main_process():
                 return
-            if self.iter_count == 2 and not self._profiling:
+            local_step = self.iter_count - learn_start
+            if local_step == 2 and not self._profiling:
                 jax.profiler.start_trace(profile_dir)
                 self._profiling = True
-            elif self._profiling and self.iter_count >= 5:
+            elif self._profiling and local_step >= 5:
                 jax.profiler.stop_trace()
                 self._profiling = False
 

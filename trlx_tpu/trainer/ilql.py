@@ -56,7 +56,11 @@ class ILQLTrainer(JaxBaseTrainer):
         )
 
         self._generate_fn = make_generate_fn(
-            self.model, self.gen_cfg, processor=self._make_ilql_processor(), carry_keys=("qs", "vs")
+            self.model,
+            self.gen_cfg,
+            processor=self._make_ilql_processor(),
+            carry_keys=("qs", "vs"),
+            step_stats_fn=self._decode_step_stats,
         )
         self.train_step = self.build_train_step()
         self._sync_fn = jax.jit(self._polyak_sync, donate_argnums=(1,))
@@ -117,46 +121,47 @@ class ILQLTrainer(JaxBaseTrainer):
 
         return processor
 
+    @staticmethod
+    def _decode_step_stats(tok, state):
+        """Per-step Q(s, tok) / V(s) straight from the generate carry — the
+        SAME target-head values that steered the sample, collected inside the
+        decode while_loop so stats cost no extra forward pass
+        (the reference gathers these inside its Python decode loop,
+        reference: trlx/model/nn/ilql_models.py:238-249)."""
+        qs = state["carry"]["qs"]
+        vs = state["carry"]["vs"]
+        q = jnp.minimum(qs[0], qs[1]) if len(qs) > 1 else qs[0]
+        q_tok = jnp.take_along_axis(q.astype(jnp.float32), tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return {"q": q_tok, "v": vs.astype(jnp.float32)}
+
     def rollout_generate(self, input_ids, attention_mask):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
         # Swap TARGET Q heads into the applied params: decode steers by the
         # target network (reference: trlx/model/nn/ilql_models.py:203-206).
         params = {**self.state.params, **self.state.extras}
-        tokens, mask = self._generate_fn({"params": params}, batch["i"], batch["m"], self.next_rng())
-        # Process-UNIFORM condition (single-host, tracking not disabled): a
-        # rank-gated jitted forward would deadlock an SPMD pod. ILQL generate
-        # runs only from evaluate() (offline method — no online rollouts), so
-        # the extra stats forward is off the training path.
+        tokens, mask, dstats = self._generate_fn(
+            {"params": params}, batch["i"], batch["m"], self.next_rng()
+        )
         import os
 
-        if jax.process_count() == 1 and "debug" not in os.environ:
-            self._log_decode_stats(params, tokens, mask)
+        if "debug" not in os.environ:
+            self._log_decode_stats(dstats, mask)
         return tokens, mask
 
-    def _log_decode_stats(self, params, tokens, mask):
-        """Q/V/advantage distributions over the DECODED tokens only
-        (≈ the wandb.Histograms the reference collects inside its Python
-        decode loop, reference: trlx/model/nn/ilql_models.py:238-249)."""
+    def _log_decode_stats(self, dstats, mask):
+        """Q/V/advantage distributions over the decoded tokens, read from the
+        in-loop stat buffers (process-local rows; stats compute is part of
+        the SPMD generate program, so this is pod-safe)."""
         P = self.prompt_length
-        if not hasattr(self, "_decode_stats_fn"):
-            def impl(params, tokens, mask):
-                out = self.model.apply({"params": params}, tokens, mask)
-                qs = out["qs"]
-                q = jnp.minimum(qs[0], qs[1]) if len(qs) > 1 else qs[0]
-                q_taken = jnp.take_along_axis(
-                    q[:, :-1].astype(jnp.float32), tokens[:, 1:, None], axis=-1
-                )[..., 0]
-                vs = out["vs"].astype(jnp.float32)[:, :-1]
-                # transitions j -> token j+1; decoded tokens start at P
-                decoded = jnp.arange(tokens.shape[1] - 1) >= P - 1
-                return q_taken, vs, q_taken - vs, mask[:, 1:] * decoded[None, :]
+        q, v, rmask = self.to_local_host((dstats["q"], dstats["v"], mask[:, P:]))
+        valid = rmask.astype(bool)
+        from trlx_tpu.parallel.mesh import is_main_process
 
-            self._decode_stats_fn = jax.jit(impl)
-        q_taken, vs, adv, valid = jax.device_get(self._decode_stats_fn(params, tokens, mask))
-        valid = valid.astype(bool)
-        self.tracker.log_histogram("decode/qs", q_taken[valid], step=self.iter_count)
-        self.tracker.log_histogram("decode/vs", vs[valid], step=self.iter_count)
-        self.tracker.log_histogram("decode/adv", adv[valid], step=self.iter_count)
+        if not is_main_process():
+            return
+        self.tracker.log_histogram("decode/qs", q[valid], step=self.iter_count)
+        self.tracker.log_histogram("decode/vs", v[valid], step=self.iter_count)
+        self.tracker.log_histogram("decode/adv", (q - v)[valid], step=self.iter_count)
 
     # ------------------------------------------------------------ train step
 

@@ -101,6 +101,23 @@ class PPOTrainer(JaxBaseTrainer):
 
         self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
         self._score_fn = jax.jit(partial(self._rollout_score_impl, prompt_length=self.prompt_length))
+
+        # On-device learned reward model: a second LM + scalar head, sharded
+        # with the SAME partition rules as the policy and scored inside the
+        # fused rollout program — the pod-scale path a host reward_fn cannot
+        # take (BASELINE.json eval config 5: NeoX-20B PPO w/ learned RM).
+        self.rm_model = None
+        self.rm_params = None
+        if config.model.has_reward_model:
+            self.rm_model, rm_host_params = self._build_reward_model(config)
+            from trlx_tpu.parallel import shard_pytree
+
+            self.rm_params, _ = shard_pytree(rm_host_params, self.mesh)
+            self._score_rm_fn = jax.jit(
+                partial(self._rollout_score_rm_impl, prompt_length=self.prompt_length)
+            )
+            self._rm_eval_fn = jax.jit(self._rm_scores)
+
         self.train_step = self.build_train_step()
 
     # ----------------------------------------------------------------- setup
@@ -131,6 +148,63 @@ class PPOTrainer(JaxBaseTrainer):
         model = LMWithValueHead(lm_cfg, branch_layer=branch_layer)
         params = load_or_init_params(model, config, self.rng)
         return model, params
+
+    def _build_reward_model(self, config: TRLConfig):
+        """Build the on-device RM: LMWithValueHead with no hydra branch; the
+        value head at the LAST VALID token is the scalar reward. Loads HF
+        trunk weights from reward_model_path or initializes from
+        reward_model_arch (from-scratch / tests)."""
+        import copy
+
+        from trlx_tpu.models.hf_import import build_lm_config, load_or_init_params
+
+        rm_config = copy.deepcopy(config)
+        rm_config.model.model_path = config.model.reward_model_path
+        rm_config.model.model_arch = dict(config.model.reward_model_arch)
+        rm_cfg = self.finalize_lm_config(build_lm_config(rm_config))
+        rm = LMWithValueHead(rm_cfg, branch_layer=-1)
+        params = load_or_init_params(rm, rm_config, self.next_rng())
+        return rm, params
+
+    @property
+    def has_reward_model(self) -> bool:
+        return self.rm_params is not None
+
+    def _rm_scores(self, rm_params, tokens, mask):
+        """Scalar reward per sequence: RM value head at the last valid token
+        (sequence-classifier convention). Logit projection skipped — the RM's
+        vocab head is never needed."""
+        out = self.rm_model.apply(
+            {"params": rm_params}, tokens, mask, compute_logits=False
+        )
+        vals = out["values"].astype(jnp.float32)  # [b, T]
+        B, T = tokens.shape
+        last_ix = T - 1 - jnp.argmax(mask[:, ::-1].astype(jnp.int32), axis=-1)
+        return vals[jnp.arange(B), last_ix]
+
+    def _rollout_score_rm_impl(self, params, extras, rm_params, tokens, mask, kl_coef, *, prompt_length: int):
+        scores = self._rm_scores(rm_params, tokens, mask)
+        lp, values, rewards, kl = self._rollout_score_impl(
+            params, extras, tokens, mask, scores, kl_coef, prompt_length=prompt_length
+        )
+        return lp, values, rewards, kl, scores
+
+    def rollout_score_rm(self, tokens, mask):
+        """Fused rollout scoring with the ON-DEVICE reward model: policy
+        logprobs + values + hydra ref KL + RM scores in one program — no
+        decode, no host boundary."""
+        return self._score_rm_fn(
+            self.state.params,
+            self.state.extras,
+            self.rm_params,
+            tokens,
+            mask,
+            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+        )
+
+    def rm_eval_scores(self, tokens, mask):
+        """RM scores for eval generations (device arrays in/out)."""
+        return self._rm_eval_fn(self.rm_params, tokens, mask)
 
     def make_extras(self, init_params):
         """The frozen ref branch = initial top-k blocks + head
