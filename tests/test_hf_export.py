@@ -1,0 +1,176 @@
+"""HF export round-trip: our pytree → HF checkpoint dir → re-import → same
+pytree, per family. The reference has no export path at all (its checkpoints
+are raw Accelerate state dirs); this guarantees the tuned policy is a
+first-class HF artifact."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.hf_export import export_hf, infer_family
+from trlx_tpu.models.hf_import import load_hf_trunk
+from trlx_tpu.models.lm import LMConfig, TransformerLM
+
+FAMILIES = {
+    "gpt2": dict(
+        pos_type="learned", parallel_residual=False, fused_qkv=True,
+        qkv_bias=True, out_bias=True, tie_word_embeddings=True,
+        activation="gelu_new",
+    ),
+    "gptj": dict(
+        pos_type="rotary", rotary_dim=8, parallel_residual=True,
+        use_parallel_ln=False, fused_qkv=False, qkv_bias=False,
+        out_bias=False, tie_word_embeddings=False, activation="gelu_new",
+        extra={"lm_head_bias": True},
+    ),
+    "gpt_neo": dict(
+        pos_type="learned", parallel_residual=False, fused_qkv=False,
+        qkv_bias=False, out_bias=True, scale_attn=False,
+        attention_layers=("global", "local"), window_size=16,
+        tie_word_embeddings=True, activation="gelu_new",
+    ),
+    "gpt_neox": dict(
+        pos_type="rotary", rotary_dim=8, parallel_residual=True,
+        use_parallel_ln=True, fused_qkv=True, qkv_bias=True,
+        tie_word_embeddings=False, activation="gelu",
+        extra={"neox_rotary": True},
+    ),
+}
+
+
+def tiny_cfg(family):
+    return LMConfig(
+        vocab_size=128,
+        n_layer=2,
+        n_head=2,
+        d_model=32,
+        max_position=64,
+        dtype="float32",
+        param_dtype="float32",
+        **FAMILIES[family],
+    )
+
+
+def assert_trees_close(a, b, path=""):
+    assert set(a) == set(b), f"{path}: {set(a) ^ set(b)}"
+    for k in a:
+        if isinstance(a[k], dict):
+            assert_trees_close(a[k], b[k], f"{path}/{k}")
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a[k], np.float32), np.asarray(b[k], np.float32),
+                atol=1e-6, err_msg=f"{path}/{k}",
+            )
+
+
+@pytest.mark.parametrize("family", list(FAMILIES))
+def test_roundtrip_trunk(family, tmp_path):
+    cfg = tiny_cfg(family)
+    assert infer_family(cfg) == family
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.arange(8)[None] % 128)
+    params = model.init(jax.random.PRNGKey(0), ids, jnp.ones_like(ids))["params"]
+
+    out = export_hf({"transformer": params}, cfg, str(tmp_path / family))
+    back = load_hf_trunk(out, cfg)
+    assert_trees_close(params, back, family)
+
+
+@pytest.mark.parametrize(
+    "family,overrides",
+    [
+        ("gpt2", {"tie_word_embeddings": False}),  # untied head must export
+        ("gptj", {"tie_word_embeddings": True, "extra": {}}),  # tied rotary
+        ("gptj", {"extra": {}}),  # untied, no lm_head bias
+        ("gpt2", {"d_ff": 48}),  # non-default inner dim → n_inner
+        ("gpt_neox", {"tie_word_embeddings": True, "activation": "gelu_new",
+                      "extra": {"neox_rotary": True}}),
+    ],
+)
+def test_roundtrip_non_canonical_variants(family, overrides, tmp_path):
+    """From-scratch archs that deviate from the family's canonical layout
+    (tying, head bias, inner dim) must still round-trip exactly."""
+    cfg = LMConfig(
+        vocab_size=128, n_layer=2, n_head=2, d_model=32, max_position=64,
+        dtype="float32", param_dtype="float32",
+        **{**FAMILIES[family], **overrides},
+    )
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.arange(8)[None] % 128)
+    params = model.init(jax.random.PRNGKey(1), ids, jnp.ones_like(ids))["params"]
+    out = export_hf({"transformer": params}, cfg, str(tmp_path / "m"))
+    back = load_hf_trunk(out, cfg)
+    assert_trees_close(params, back, f"{family}+{overrides}")
+
+
+def test_export_rejects_unrepresentable_semantics(tmp_path):
+    """Semantics HF can't express must fail loudly, not export wrong logits."""
+    from trlx_tpu.models.hf_export import validate_exportable
+
+    scaled_neo = tiny_cfg("gpt_neo").replace(scale_attn=True)
+    with pytest.raises(ValueError, match="UNSCALED"):
+        validate_exportable(scaled_neo, "gpt_neo")
+    unscaled_gpt2 = tiny_cfg("gpt2").replace(scale_attn=False)
+    with pytest.raises(ValueError, match="scale_attn"):
+        validate_exportable(unscaled_gpt2, "gpt2")
+    neox_rot_gptj = tiny_cfg("gptj").replace(extra={"neox_rotary": True})
+    with pytest.raises(ValueError, match="interleaved"):
+        validate_exportable(neox_rot_gptj, "gptj")
+
+
+def test_soft_prompt_exports_to_sidecar(tmp_path):
+    """A tuned soft prompt has no HF slot — it must land in the heads npz,
+    not silently vanish."""
+    cfg = tiny_cfg("gpt2").replace(n_soft_tokens=4)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.arange(8)[None] % 128)
+    params = model.init(jax.random.PRNGKey(0), ids, jnp.ones_like(ids))["params"]
+    assert "soft_prompt" in params
+    out = export_hf({"transformer": params}, cfg, str(tmp_path / "m"))
+    data = np.load(f"{out}/trlx_tpu_heads.npz")
+    np.testing.assert_allclose(
+        data["soft_prompt"], np.asarray(params["soft_prompt"], np.float32)
+    )
+
+
+def test_export_includes_rl_heads(tmp_path):
+    cfg = tiny_cfg("gpt2")
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.arange(8)[None] % 128)
+    params = model.init(jax.random.PRNGKey(0), ids, jnp.ones_like(ids))["params"]
+    heads = {"v_head": {"layers_0": {"kernel": np.ones((32, 64), np.float32)}}}
+    out = export_hf({"transformer": params}, cfg, str(tmp_path / "m"), head_params=heads)
+    data = np.load(f"{out}/trlx_tpu_heads.npz")
+    np.testing.assert_array_equal(data["v_head/layers_0/kernel"], np.ones((32, 64)))
+
+
+def test_trainer_save_pretrained_roundtrips(tmp_path):
+    """End-to-end: a PPOTrainer's trained params export to an HF dir that a
+    FRESH trainer can load as model_path — the full RLHF→HF→RLHF cycle."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+    from randomwalks import base_config
+
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    config = base_config("ppo", 15, 8)
+    config.train.batch_size = 16
+    config.method.chunk_size = 16
+    config.method.num_rollouts = 16
+    config.train.checkpoint_dir = str(tmp_path / "ck")
+    # randomwalks arch is gpt2-family modulo flags; force canonical gpt2
+    config.model.model_arch.update(
+        {"pos_type": "learned", "fused_qkv": True, "tie_word_embeddings": True}
+    )
+    trainer = PPOTrainer(config)
+    out = trainer.save_pretrained(str(tmp_path / "hf"))
+
+    from trlx_tpu.models.hf_import import load_hf_trunk
+
+    back = load_hf_trunk(out, trainer.model.cfg)
+    orig = jax.device_get(trainer.state.params)["transformer"]
+    assert_trees_close(orig, back, "trainer")

@@ -172,6 +172,10 @@ def convert_gpt2(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
         "wpe": {"embedding": sd["transformer.wpe.weight"]},
         "ln_f": _ln(sd, "transformer.ln_f"),
     }
+    if not cfg.tie_word_embeddings:
+        # Canonical gpt2 ties; an untied checkpoint (e.g. our own export of
+        # an untied from-scratch arch) carries a real head.
+        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         p[f"h_{i}"] = {
@@ -194,8 +198,11 @@ def convert_gptj(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
     p: Dict[str, Any] = {
         "wte": {"embedding": sd["transformer.wte.weight"]},
         "ln_f": _ln(sd, "transformer.ln_f"),
-        "lm_head": {"kernel": sd["lm_head.weight"].T, "bias": sd["lm_head.bias"]},
     }
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+        if cfg.extra.get("lm_head_bias", False):
+            p["lm_head"]["bias"] = sd["lm_head.bias"]
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         p[f"h_{i}"] = {
@@ -222,6 +229,8 @@ def convert_gpt_neo(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
         "wpe": {"embedding": sd["transformer.wpe.weight"]},
         "ln_f": _ln(sd, "transformer.ln_f"),
     }
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         a = f"{h}.attn.attention"
@@ -259,8 +268,9 @@ def convert_neox(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
     p: Dict[str, Any] = {
         "wte": {"embedding": sd["gpt_neox.embed_in.weight"]},
         "ln_f": _ln(sd, "gpt_neox.final_layer_norm"),
-        "lm_head": {"kernel": sd["embed_out.weight"].T},
     }
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = {"kernel": sd["embed_out.weight"].T}
     for i in range(cfg.n_layer):
         h = f"gpt_neox.layers.{i}"
         p[f"h_{i}"] = {

@@ -526,6 +526,25 @@ class JaxBaseTrainer(BaseRLTrainer):
             with open(os.path.join(directory, "latest.txt"), "w") as f:
                 f.write(name)
 
+    def save_pretrained(self, out_dir: str, family: Optional[str] = None):
+        """Export the trained policy trunk as an ordinary HuggingFace
+        checkpoint (+ RL heads in trlx_tpu_heads.npz) — the handoff to the
+        HF serving/eval ecosystem the reference leaves to manual
+        Accelerate-state unwrapping. Single-host: a pod should first land an
+        orbax checkpoint and export from a one-host restore."""
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "save_pretrained gathers full params on one host — run it "
+                "single-host from an orbax checkpoint restore"
+            )
+        from trlx_tpu.models.hf_export import export_hf
+
+        params = jax.device_get(self.state.params)
+        heads = {k: v for k, v in params.items() if k != "transformer"}
+        return export_hf(
+            params, self.model.cfg, out_dir, family=family, head_params=heads
+        )
+
     def load(self, directory: Optional[str] = None):
         """Restore a TrainState + host state saved by `save` (resume support
         the reference lacks)."""
