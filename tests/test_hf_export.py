@@ -118,6 +118,19 @@ def test_export_rejects_unrepresentable_semantics(tmp_path):
     neox_rot_gptj = tiny_cfg("gptj").replace(extra={"neox_rotary": True})
     with pytest.raises(ValueError, match="interleaved"):
         validate_exportable(neox_rot_gptj, "gptj")
+    # structural mismatches: residual style, biases, local attention
+    parallel_gpt2 = tiny_cfg("gpt2").replace(parallel_residual=True)
+    with pytest.raises(ValueError, match="sequential"):
+        validate_exportable(parallel_gpt2, "gpt2")
+    biased_gptj = tiny_cfg("gptj").replace(qkv_bias=True)
+    with pytest.raises(ValueError, match="qkv_bias"):
+        validate_exportable(biased_gptj, "gptj")
+    out_biased_gptj = tiny_cfg("gptj").replace(out_bias=True)
+    with pytest.raises(ValueError, match="out_bias"):
+        validate_exportable(out_biased_gptj, "gptj")
+    local_gpt2 = tiny_cfg("gpt2").replace(attention_layers=("global", "local"))
+    with pytest.raises(ValueError, match="local-attention"):
+        validate_exportable(local_gpt2, "gpt2")
 
 
 def test_soft_prompt_exports_to_sidecar(tmp_path):

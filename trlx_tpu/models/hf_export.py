@@ -42,6 +42,24 @@ def validate_exportable(cfg: LMConfig, family: str):
             problems.append("HF gpt_neo attention is UNSCALED: requires scale_attn=False")
     elif not cfg.scale_attn:
         problems.append(f"HF {family} scales attention by 1/sqrt(head_dim): requires scale_attn=True")
+    # Residual structure is fixed per family.
+    wants_parallel = family in ("gptj", "gpt_neox")
+    if cfg.parallel_residual != wants_parallel:
+        problems.append(
+            f"HF {family} uses {'parallel' if wants_parallel else 'sequential'} "
+            f"residuals: requires parallel_residual={wants_parallel}"
+        )
+    # Attention-projection biases are fixed per family; a trained bias the
+    # family can't carry would silently vanish from the checkpoint.
+    want_qkv_bias = family in ("gpt2", "gpt_neox")
+    want_out_bias = family != "gptj"
+    if cfg.qkv_bias != want_qkv_bias:
+        problems.append(f"HF {family} q/k/v projections: requires qkv_bias={want_qkv_bias}")
+    if cfg.out_bias != want_out_bias:
+        problems.append(f"HF {family} attention out projection: requires out_bias={want_out_bias}")
+    # Local-attention layer patterns exist only in gpt_neo.
+    if family != "gpt_neo" and any(t == "local" for t in cfg.attention_layers):
+        problems.append(f"HF {family} has no local-attention layers: requires all-global attention_layers")
     if family == "gptj":
         if cfg.extra.get("neox_rotary"):
             problems.append("HF gptj uses interleaved rotary: drop extra.neox_rotary")
