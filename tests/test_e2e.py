@@ -349,6 +349,32 @@ def test_ppo_with_on_device_reward_model(task, tmp_path):
     assert np.isfinite(scores).all()
 
 
+def test_log_interval_skips_stat_reads(task, tmp_path):
+    """train.log_interval > 1 logs (and syncs stats) only every Nth step —
+    the reference reads this field but never defines it
+    (trlx/model/__init__.py:137)."""
+    import json
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = 4
+    config.train.log_interval = 2
+    config.train.eval_interval = 100  # no eval logs in the window
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+    assert model.iter_count >= 4
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    # train-step stat lines carry "loss"; rollout/eval lines don't
+    logged_train_steps = [r["step"] for r in recs if "loss" in r]
+    assert logged_train_steps, "nothing logged at all"
+    assert set(logged_train_steps) <= {2, 4}, logged_train_steps
+
+
 def test_offline_orchestrator_degenerate_samples(task):
     """Prompt-only / over-truncated samples must not crash experience
     building (empty action rows are padded no-ops in the storage)."""

@@ -91,6 +91,11 @@ class TrainConfig:
 
     checkpoint_interval: int = 1000
     eval_interval: int = 100
+    # Read stats/log every N steps. Reading a jitted step's stats forces a
+    # host⇄device sync; >1 keeps the device queue full between logs (the
+    # reference reads a log_interval that its config never defines,
+    # reference: trlx/model/__init__.py:137).
+    log_interval: int = 1
 
     pipeline: str = "PromptPipeline"
     orchestrator: str = "PPOOrchestrator"

@@ -42,13 +42,15 @@ def validate_exportable(cfg: LMConfig, family: str):
             problems.append("HF gpt_neo attention is UNSCALED: requires scale_attn=False")
     elif not cfg.scale_attn:
         problems.append(f"HF {family} scales attention by 1/sqrt(head_dim): requires scale_attn=True")
-    # Residual structure is fixed per family.
-    wants_parallel = family in ("gptj", "gpt_neox")
-    if cfg.parallel_residual != wants_parallel:
-        problems.append(
-            f"HF {family} uses {'parallel' if wants_parallel else 'sequential'} "
-            f"residuals: requires parallel_residual={wants_parallel}"
-        )
+    # Residual structure is fixed per family — except gpt_neox, whose HF
+    # config carries use_parallel_residual itself (both styles exportable).
+    if family != "gpt_neox":
+        wants_parallel = family == "gptj"
+        if cfg.parallel_residual != wants_parallel:
+            problems.append(
+                f"HF {family} uses {'parallel' if wants_parallel else 'sequential'} "
+                f"residuals: requires parallel_residual={wants_parallel}"
+            )
     # Attention-projection biases are fixed per family; a trained bias the
     # family can't carry would silently vanish from the checkpoint.
     want_qkv_bias = family in ("gpt2", "gpt_neox")

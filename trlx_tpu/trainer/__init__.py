@@ -75,9 +75,11 @@ class BaseRLTrainer:
 
     def intervals(self, steps: int) -> Dict[str, bool]:
         """Which per-step side effects fire
-        (reference: trlx/model/__init__.py:131-140, minus the stale
-        log_interval field the reference reads but never defines)."""
+        (reference: trlx/model/__init__.py:131-140 — which reads a
+        log_interval field its TrainConfig never defines; here the field
+        exists and works)."""
         return {
             "do_checkpoint": steps % self.config.train.checkpoint_interval == 0,
             "do_eval": steps % self.config.train.eval_interval == 0,
+            "do_log": steps % self.config.train.log_interval == 0,
         }

@@ -462,20 +462,25 @@ class JaxBaseTrainer(BaseRLTrainer):
                     intervals = self.intervals(self.iter_count)
                     if intervals["do_checkpoint"]:
                         self.save()
-                    # Reading stats forces a device sync — the price of
-                    # per-step logging, as in the reference's per-step
-                    # accelerator.log (reference:
-                    # trlx/model/accelerate_base_model.py:244).
-                    stats_host = {k: float(v) for k, v in stats.items()}
-                    if intervals["do_eval"]:
-                        stats_host.update(self.evaluate())
-                    self.tracker.log(stats_host, step=self.iter_count)
-                    stats_host["step_time"] = time.time() - forward_t0
-                    stats_host["samples_per_sec"] = (
-                        self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
-                    )
-
-                    self.post_backward_callback(stats_host)
+                    if intervals["do_log"] or intervals["do_eval"]:
+                        # Reading stats forces a device sync — the price of
+                        # logging (per-step by default, as in the reference's
+                        # accelerator.log, reference:
+                        # trlx/model/accelerate_base_model.py:244). With
+                        # log_interval > 1 the device queue stays full
+                        # between logs; the adaptive KL controller then also
+                        # updates only on logged steps.
+                        stats_host = {k: float(v) for k, v in stats.items()}
+                        if intervals["do_eval"]:
+                            stats_host.update(self.evaluate())
+                        self.tracker.log(stats_host, step=self.iter_count)
+                        stats_host["step_time"] = time.time() - forward_t0
+                        stats_host["samples_per_sec"] = (
+                            self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
+                        )
+                        self.post_backward_callback(stats_host)
+                    else:
+                        self.post_backward_callback(None)
 
                     if self._preempted:
                         self._save_on_preemption()

@@ -318,9 +318,15 @@ class PPOTrainer(JaxBaseTrainer):
 
     def post_backward_callback(self, stats=None):
         """KL-coefficient update from the policy-vs-rollout KL
-        (reference: trlx/model/accelerate_ppo_model.py:163-165)."""
+        (reference: trlx/model/accelerate_ppo_model.py:163-165). With
+        log_interval > 1 the callback sees stats only every Nth step, so
+        n_steps scales by N to keep the adaptation rate invariant to the
+        logging cadence."""
         if stats and "mean_kl" in stats:
-            self.kl_ctl.update(stats["mean_kl"], self.config.train.batch_size)
+            self.kl_ctl.update(
+                stats["mean_kl"],
+                self.config.train.batch_size * self.config.train.log_interval,
+            )
 
     def post_epoch_callback(self):
         """Alternate back to rollout
