@@ -181,6 +181,8 @@ def run_one(cand):
     # Tuning knobs (experimentation; the shipped SIZES carry the defaults).
     B = int(os.environ.get("BENCH_BATCH", B))
     C = int(os.environ.get("BENCH_CHUNK", C))
+    P = int(os.environ.get("BENCH_PROMPT", P))
+    R = int(os.environ.get("BENCH_DECODE", R))
     remat_env = os.environ.get("BENCH_REMAT")
     from trlx_tpu.data import PPORLBatch
     from trlx_tpu.trainer.api import default_config

@@ -56,6 +56,18 @@ assert full.shape == (8, 3)
 np.testing.assert_array_equal(full[:4], (np.arange(12).reshape(4, 3)) * 2)
 np.testing.assert_array_equal(full[4:], (np.arange(12).reshape(4, 3) + 100) * 2)
 
+# Preemption agreement — the REAL trainer method on both processes: only
+# proc 1 has the SIGTERM flag, yet both must agree True so the collective
+# save is entered together.
+from trlx_tpu.trainer.base import JaxBaseTrainer
+stub = object.__new__(JaxBaseTrainer)
+stub._preempted = (pid == 1)
+assert stub._preemption_agreed(), f"proc {pid} disagreed on preemption"
+stub._preempted = False
+# (all-False must agree False — no spurious saves; note BOTH procs must
+# still enter the collective with the same flag values)
+assert not stub._preemption_agreed(), f"proc {pid} false-positive preemption"
+
 print(f"proc {pid} OK")
 """
 

@@ -408,10 +408,11 @@ class JaxBaseTrainer(BaseRLTrainer):
         # Preemption/failure handling the reference lacks entirely ("crash =
         # job death", SURVEY.md §5): SIGTERM (TPU preemption notice, k8s
         # eviction) requests a checkpoint at the next safe boundary, so a
-        # resumable state lands before the VM disappears. Single-host only:
-        # the orbax save is collective, and an unsynchronized per-process
-        # flag would deadlock a pod (multi-host wants process-agreed
-        # preemption, e.g. orbax CheckpointManager's sync point).
+        # resumable state lands before the VM disappears. Multi-host safe:
+        # the local SIGTERM flag is only acted on after PROCESS AGREEMENT
+        # (an any-reduce at each batch boundary, see _preemption_agreed) so
+        # every host enters the collective orbax save together — an
+        # unsynchronized per-process flag would deadlock a pod.
         import signal
 
         self._preempted = False
@@ -421,12 +422,11 @@ class JaxBaseTrainer(BaseRLTrainer):
 
         old_handler = None
         handler_installed = False
-        if jax.process_count() == 1:
-            try:
-                old_handler = signal.signal(signal.SIGTERM, on_sigterm)
-                handler_installed = True
-            except ValueError:  # not in main thread
-                pass
+        try:
+            old_handler = signal.signal(signal.SIGTERM, on_sigterm)
+            handler_installed = True
+        except ValueError:  # not in main thread
+            pass
 
         try:
             return self._learn_loop(profiler_tick)
@@ -443,13 +443,28 @@ class JaxBaseTrainer(BaseRLTrainer):
         self.save()
         self.tracker.log({"preempted_at_step": self.iter_count}, step=self.iter_count)
 
+    def _preemption_agreed(self) -> bool:
+        """True when ANY process has a pending SIGTERM.
+
+        Multi-host: an any-reduce over the per-process flags — every host
+        returns the same answer, so the collective checkpoint save is
+        entered by all or by none (a TPU pod's preemption notice doesn't hit
+        every VM at the same instant). Single-process: the local flag."""
+        from trlx_tpu.parallel.mesh import allgather_host
+
+        return bool(
+            np.any(allgather_host(np.asarray([self._preempted], dtype=np.int32)))
+        )
+
     def _learn_loop(self, profiler_tick):
         for epoch in range(self.config.train.epochs):
             for batch in self.train_dataloader:
                 # SIGTERM may land during the (long) rollout phase that
                 # rebuilt this dataloader — checkpoint before spending a
-                # further step on a doomed VM.
-                if self._preempted:
+                # further step on a doomed VM. Checked once per BATCH (not
+                # per step): the agreement collective stays off the hot
+                # step loop.
+                if self._preemption_agreed():
                     self._save_on_preemption()
                     return None
                 device_batch = self.put_batch(batch)
@@ -482,7 +497,11 @@ class JaxBaseTrainer(BaseRLTrainer):
                     else:
                         self.post_backward_callback(None)
 
-                    if self._preempted:
+                    # Mid-batch reaction stays single-process-only: a
+                    # per-step agreement collective would tax the hot loop,
+                    # and a local-only save would deadlock a pod — pods
+                    # react at the next batch boundary instead.
+                    if jax.process_count() == 1 and self._preempted:
                         self._save_on_preemption()
                         return None
 
