@@ -15,9 +15,13 @@ replays HF GPT-2 blocks); here the kernel is ours, built for the MXU:
 - custom VJP with two backward kernels (dq; dk/dv) that recompute P from the
   saved log-sum-exp instead of storing probabilities.
 
-All matmuls run in fp32 on the MXU via preferred_element_type; inputs may be
-bf16. Interpret mode (CPU) is auto-selected off-TPU so the same code path is
-unit-testable in CI.
+All matmuls ACCUMULATE in fp32 via preferred_element_type (multiplies run at
+the MXU's native bf16 granularity, same precision class as XLA's default
+einsum path on TPU); inputs may be bf16. Interpret mode (CPU) is
+auto-selected off-TPU so the same code path is unit-testable in CI; measured
+on a v5e, the kernel matches the XLA einsum path within mutual bf16 noise
+(~1e-2 at T=1024 fp32 inputs) and the parallel grid dimension_semantics are
+bit-identical to sequential execution.
 """
 
 import functools
