@@ -84,9 +84,15 @@ def test_greedy_matches_hf_generate(tmp_path):
     hf = transformers.AutoModelForCausalLM.from_pretrained(out_dir)
     full = np.concatenate([np.asarray(ids), ours], axis=1)
     full_mask = np.concatenate([np.asarray(mask), np.ones((B, N), np.int32)], axis=1)
+    # HF's bare forward does NOT derive position ids from the attention mask
+    # (only generate() does) — pass the left-pad-aware positions explicitly,
+    # the same cumsum rule both we and HF generate() use.
+    position_ids = np.maximum(full_mask.cumsum(axis=1) - 1, 0)
     with torch.no_grad():
         logits = hf(
-            input_ids=torch.tensor(full), attention_mask=torch.tensor(full_mask)
+            input_ids=torch.tensor(full),
+            attention_mask=torch.tensor(full_mask),
+            position_ids=torch.tensor(position_ids),
         ).logits.numpy()
     for b in range(B):
         for t in range(N):
