@@ -34,6 +34,7 @@ def test_ppo_e2e_smoke(task, tmp_path):
     walks, logit_mask, metric_fn, reward_fn = task
     config = shrink(base_config("ppo", 15, 8))
     config.train.checkpoint_dir = str(tmp_path)
+    config.model.kv_cache_quant = True  # int8 decode cache path in CI
     prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
     model = trlx_tpu.train(
         reward_fn=reward_fn,

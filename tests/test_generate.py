@@ -106,6 +106,70 @@ def test_greedy_matches_hf_generate(tmp_path):
                 )
 
 
+def test_int8_kv_cache_decode_close_to_fp():
+    """kv_cache_quant=True: cached decode logits stay close to the
+    full-precision path (per-token-per-head absmax int8), and greedy decode
+    emits the same tokens on a well-separated tiny model."""
+    cfg = LMConfig(vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=64, dtype="float32")
+    model = LMWithValueHead(cfg)
+    model_q = LMWithValueHead(cfg.replace(kv_cache_quant=True))
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (3, 6), 2, cfg.vocab_size)
+    mask = jnp.ones((3, 6), jnp.int32).at[0, :2].set(0)
+    ids = ids.at[0, :2].set(0)
+    params = {"params": model.init(rng, ids, mask)["params"]}
+
+    gcfg = GenerateConfig(max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0)
+    toks_fp, m_fp = make_generate_fn(model, gcfg)(params, ids, mask, jax.random.PRNGKey(1))
+    toks_q, m_q = make_generate_fn(model_q, gcfg)(params, ids, mask, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(toks_fp), np.asarray(toks_q))
+    np.testing.assert_array_equal(np.asarray(m_fp), np.asarray(m_q))
+
+    # logits comparison under teacher forcing through the quantized cache
+    from trlx_tpu.models.lm import init_cache
+
+    T = int(toks_fp.shape[1])
+    cfg_q = model_q.cfg
+    cache = init_cache(cfg_q, 3, T + 1)  # room for one extra decode step
+    prefill_mask = jnp.concatenate([m_fp, jnp.zeros((3, 1), jnp.int32)], axis=1)
+    out_q = model_q.apply(
+        params, toks_fp, m_fp, cache=cache, cache_index=0, cache_mask=prefill_mask
+    )
+    out_fp = model.apply(params, toks_fp, m_fp)
+    # einsum prefill reads through the quantized cache → int8-grade closeness
+    np.testing.assert_allclose(
+        np.asarray(out_q["logits"]), np.asarray(out_fp["logits"]), atol=0.15
+    )
+
+    # single-token decode step reads the QUANTIZED cache → int8-grade
+    step_out_q = model_q.apply(
+        params,
+        toks_fp[:, -1:] * 0 + 5,
+        jnp.ones((3, 1), jnp.int32),
+        cache=out_q["cache"],
+        cache_index=T,
+        cache_mask=jnp.concatenate([m_fp, jnp.ones((3, 1), jnp.int32)], axis=1),
+    )
+    # fp reference for the same step
+    cache_fp = init_cache(cfg, 3, T + 1)
+    out_fp_c = model.apply(
+        params, toks_fp, m_fp, cache=cache_fp, cache_index=0, cache_mask=prefill_mask
+    )
+    step_out_fp = model.apply(
+        params,
+        toks_fp[:, -1:] * 0 + 5,
+        jnp.ones((3, 1), jnp.int32),
+        cache=out_fp_c["cache"],
+        cache_index=T,
+        cache_mask=jnp.concatenate([m_fp, jnp.ones((3, 1), jnp.int32)], axis=1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(step_out_q["logits"]), np.asarray(step_out_fp["logits"]), atol=0.15
+    )
+    rel = np.abs(np.asarray(step_out_q["logits"]) - np.asarray(step_out_fp["logits"])).max()
+    assert rel > 0  # the quantized path is actually different code
+
+
 def test_eos_finishes_and_pads():
     model, params, ids, mask = setup_model()
     # eos that the greedy decode definitely emits: run once to find one

@@ -52,6 +52,9 @@ class ModelConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
+    # int8 decode KV cache (halves cache HBM traffic + memory; see
+    # LMConfig.kv_cache_quant). Off by default.
+    kv_cache_quant: bool = False
     reward_model_path: str = ""
     reward_model_arch: Dict[str, Any] = field(default_factory=dict)
 

@@ -21,7 +21,10 @@ def build_lm_config(config) -> LMConfig:
     """Resolve an LMConfig from model_arch overrides or an HF config."""
     mc = config.model
     base: Dict[str, Any] = dict(
-        dtype=mc.dtype, param_dtype=mc.param_dtype, remat=mc.remat
+        dtype=mc.dtype,
+        param_dtype=mc.param_dtype,
+        remat=mc.remat,
+        kv_cache_quant=getattr(mc, "kv_cache_quant", False),
     )
     if mc.model_arch:
         return LMConfig.from_dict({**base, **mc.model_arch})

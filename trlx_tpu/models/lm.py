@@ -72,6 +72,14 @@ class LMConfig:
     # the sp-axis ring (trlx_tpu/parallel/ring_attention.py). Set by the
     # trainer from the mesh; 0/1 disables.
     sp_size: int = 0
+    # int8 KV cache (per-token-per-head absmax scales): decode attention is
+    # HBM-bandwidth-bound on cache reads at scale — int8 halves that traffic
+    # and halves cache memory (longer sequences / larger rollout chunks per
+    # chip). Only cache READS see quantization error: decode steps always,
+    # and prefill only when it takes the einsum-over-cache path (flash
+    # prefill attends over the unquantized local block). Scoring/training
+    # passes have no cache and always run full precision.
+    kv_cache_quant: bool = False
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
@@ -235,16 +243,33 @@ class Attention(nn.Module):
 
         new_cache = None
         if cache is not None:
-            k_cache, v_cache = cache
-            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
-            new_cache = (k_cache, v_cache)
-            # Flash prefill attends over the LOCAL block only (cache slots
-            # beyond the prompt are invalid until decode) — k/v stay local.
-            # The einsum paths (decode steps, unaligned prefill) attend over
-            # the cache buffers with the cache-validity bias.
-            if flash_mask is None:
-                k, v = k_cache, v_cache
+            if cfg.kv_cache_quant:
+                k_cache, v_cache, ks_cache, vs_cache = cache
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                k_cache = jax.lax.dynamic_update_slice(k_cache, kq, (0, cache_index, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, vq, (0, cache_index, 0, 0))
+                ks_cache = jax.lax.dynamic_update_slice(ks_cache, ks, (0, cache_index, 0))
+                vs_cache = jax.lax.dynamic_update_slice(vs_cache, vs, (0, cache_index, 0))
+                new_cache = (k_cache, v_cache, ks_cache, vs_cache)
+                if flash_mask is None:
+                    # Dequantize on read: XLA fuses int8→compute convert +
+                    # scale into the attention contraction's operand load, so
+                    # HBM traffic is the int8 bytes.
+                    k = k_cache.astype(dtype) * ks_cache[..., None].astype(dtype)
+                    v = v_cache.astype(dtype) * vs_cache[..., None].astype(dtype)
+            else:
+                k_cache, v_cache = cache
+                k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
+                new_cache = (k_cache, v_cache)
+                # Flash prefill attends over the LOCAL block only (cache
+                # slots beyond the prompt are invalid until decode) — k/v
+                # stay local. The einsum paths (decode steps, unaligned
+                # prefill) attend over the cache buffers with the
+                # cache-validity bias.
+                if flash_mask is None:
+                    k, v = k_cache, v_cache
 
         scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
         if flash_mask is not None:
@@ -522,9 +547,30 @@ class TransformerLM(nn.Module):
         }
 
 
+def quantize_kv(x: jnp.ndarray):
+    """[b, t, h, d] → (int8 values, [b, t, h] fp32 absmax scales)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0 + 1e-12
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def init_cache(cfg: LMConfig, batch: int, max_len: int, dtype=None):
-    """Allocate an empty KV cache pytree: per-layer (k, v) [b, T, n_head, hd]."""
-    dtype = dtype or cfg.compute_dtype
+    """Allocate an empty KV cache pytree: per-layer (k, v) [b, T, n_head, hd],
+    or (k_i8, v_i8, k_scale, v_scale) with kv_cache_quant."""
     shape = (batch, max_len, cfg.n_head, cfg.head_dim)
+    if cfg.kv_cache_quant:
+        assert dtype is None, "kv_cache_quant caches are int8; dtype not honored"
+        sshape = (batch, max_len, cfg.n_head)
+        return tuple(
+            (
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(sshape, dtype=jnp.float32),
+                jnp.ones(sshape, dtype=jnp.float32),
+            )
+            for _ in range(cfg.n_layer)
+        )
+    dtype = dtype or cfg.compute_dtype
     zero = lambda: jnp.zeros(shape, dtype=dtype)
     return tuple((zero(), zero()) for _ in range(cfg.n_layer))

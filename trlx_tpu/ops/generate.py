@@ -90,11 +90,15 @@ def generate(
         data = int(mesh.shape[mesh_mod.AXIS_DP]) * int(mesh.shape[mesh_mod.AXIS_FSDP])
         tp = int(mesh.shape[mesh_mod.AXIS_TP])
         if B % data == 0 and cfg.n_head % tp == 0:
-            kv_sharding = NamedSharding(
-                mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP, None)
-            )
+            # 4-D leaves are k/v ([b, T, h, d]); 3-D leaves are the int8
+            # cache's per-slot scales ([b, T, h]).
+            spec4 = NamedSharding(mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP, None))
+            spec3 = NamedSharding(mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP))
             cache = jax.tree_util.tree_map(
-                lambda x: jax.lax.with_sharding_constraint(x, kv_sharding), cache
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, spec4 if x.ndim == 4 else spec3
+                ),
+                cache,
             )
         elif mesh.size > 1:
             import warnings
