@@ -40,6 +40,12 @@ def test_trainer_registry_names():
     from trlx_tpu.trainer import get_model
 
     # reference-compatible names resolve (reference: configs/*.yml model_type)
+    # + the BASELINE north-star's backend names
+    from trlx_tpu.trainer.ilql import ILQLTrainer
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    assert get_model("TPUJaxPPOModel") is PPOTrainer
+    assert get_model("TPUJaxILQLModel") is ILQLTrainer
     assert get_model("AcceleratePPOModel") is get_model("ppo")
     assert get_model("ILQLModel") is get_model("ilql")
 

@@ -40,7 +40,13 @@ def train(
     config: Optional[TRLConfig] = None,
     split_token: Optional[str] = None,
     logit_mask=None,
+    backend: str = "tpu",
 ):
+    # `backend` exists for drop-in compatibility with the
+    # `trlx.train(..., backend='tpu')` call shape; this framework IS the
+    # tpu backend.
+    if backend not in ("tpu", "jax"):
+        raise ValueError(f"trlx_tpu only implements the tpu/jax backend, got {backend!r}")
     has_rm = config is not None and config.model.has_reward_model
     if reward_fn is not None and has_rm:
         raise ValueError(

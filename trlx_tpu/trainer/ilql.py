@@ -33,6 +33,7 @@ from trlx_tpu.trainer.base import JaxBaseTrainer
 @register_model("ilql")
 @register_model("ILQLModel")
 @register_model("AccelerateILQLModel")
+@register_model("TPUJaxILQLModel")  # the BASELINE north-star's name
 class ILQLTrainer(JaxBaseTrainer):
     def __init__(self, config: TRLConfig, **kwargs):
         super().__init__(config, **kwargs)

@@ -54,6 +54,7 @@ class FixedKLController:
 
 @register_model("ppo")
 @register_model("AcceleratePPOModel")  # reference-compatible registry name
+@register_model("TPUJaxPPOModel")  # the BASELINE north-star's name
 @register_model("PPOTrainer")
 class PPOTrainer(JaxBaseTrainer):
     def __init__(self, config: TRLConfig, **kwargs):

@@ -19,9 +19,11 @@ def train(
     config: Optional[TRLConfig] = None,
     split_token: Optional[str] = None,
     logit_mask: Optional[List[List[bool]]] = None,
+    backend: str = "tpu",
 ):
     """Dispatch to online PPO (reward_fn) or offline ILQL (dataset)
-    (reference: trlx/trlx.py:13-93)."""
+    (reference: trlx/trlx.py:13-93). `backend` accepts "tpu"/"jax" for
+    drop-in compatibility with `trlx.train(..., backend='tpu')`."""
     # Import here: trainer modules register themselves at import time.
     try:
         from trlx_tpu.trainer.api import train as _train
@@ -40,4 +42,5 @@ def train(
         config=config,
         split_token=split_token,
         logit_mask=logit_mask,
+        backend=backend,
     )
