@@ -100,6 +100,20 @@ def test_neox_20b_fits_pod():
     assert per_dev * 3 < 4e9, f"per-device state {per_dev*3/1e9:.1f}GB too large"
 
 
+def test_neox_20b_policy_plus_rm_fits_pod():
+    """The ppo_neox20b_rm recipe (BASELINE.md eval config 5): policy master
+    params + masked Adam moments + frozen hydra branch + a FULL on-device
+    20B reward model, all sharded over the recipe's fsdp=8 × tp=4 axes, must
+    sit well inside a v4 chip's 32GB HBM."""
+    total, per_dev = per_device_param_bytes(NEOX_20B, (1, 8, 4, 1))
+    assert total > 75e9  # ~20B fp32 each
+    rm_per_dev = per_dev  # same arch, same partition rules
+    moments_frac = 0.15  # num_layers_unfrozen=2 of 44 + embeddings/heads
+    branch_frac = 0.12  # top-2 blocks + ln_f + lm_head snapshot
+    budget = per_dev * (1 + 2 * moments_frac + branch_frac) + rm_per_dev
+    assert budget < 12e9, f"{budget/1e9:.1f}GB/chip static state too large for v4"
+
+
 def test_every_large_param_is_sharded():
     """No >=d_model^2 tensor may fall through the partition rules to full
     replication — that is how pods OOM at scale."""
