@@ -159,6 +159,35 @@ def test_export_includes_rl_heads(tmp_path):
     np.testing.assert_array_equal(data["v_head/layers_0/kernel"], np.ones((32, 64)))
 
 
+def test_ilql_trainer_save_pretrained_exports_q_heads(tmp_path):
+    """ILQL export: trunk becomes the HF checkpoint; the vocab-wide Q heads
+    and V head ride in the sidecar npz."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+    from randomwalks import base_config
+
+    from trlx_tpu.trainer.ilql import ILQLTrainer
+
+    config = base_config("ilql", 15, 8)
+    config.train.batch_size = 16
+    config.train.checkpoint_dir = str(tmp_path / "ck")
+    config.model.model_arch.update(
+        {"pos_type": "learned", "fused_qkv": True, "tie_word_embeddings": True}
+    )
+    trainer = ILQLTrainer(config)
+    out = trainer.save_pretrained(str(tmp_path / "hf"))
+    data = np.load(f"{out}/trlx_tpu_heads.npz")
+    head_keys = set(data.files)
+    assert any(k.startswith("q1_head/") for k in head_keys)
+    assert any(k.startswith("q2_head/") for k in head_keys)
+    assert any(k.startswith("v_head/") for k in head_keys)
+    back = load_hf_trunk(out, trainer.model.cfg)
+    orig = jax.device_get(trainer.state.params)["transformer"]
+    assert_trees_close(orig, back, "ilql-trainer")
+
+
 def test_trainer_save_pretrained_roundtrips(tmp_path):
     """End-to-end: a PPOTrainer's trained params export to an HF dir that a
     FRESH trainer can load as model_path — the full RLHF→HF→RLHF cycle."""
