@@ -1,0 +1,414 @@
+"""Golden numerical-parity harness: runs the reference's OWN torch loss code
+(`/root/reference/trlx`, imported read-only) against this repo's JAX losses on
+identical synthetic tensors, asserting loss AND gradient parity to 1e-5.
+
+What is executed on the torch side is the real, unmodified reference method —
+`AcceleratePPOModel.loss` (reference: trlx/model/accelerate_ppo_model.py:76-155)
+and `AccelerateILQLModel.loss` (reference: trlx/model/accelerate_ilql_model.py:
+50-156) — bound to a stub `self` whose `model` returns pre-made differentiable
+tensors, so the full arithmetic (GAE loop, whiten, clipped pg/vf, double-Q TD,
+expectile-V, CQL, AWAC) runs exactly as shipped.
+
+Documented deviations (SURVEY.md §7 do-not-reproduce list), and how each is
+handled here:
+
+1. Advantage whitening over padding. The reference whitens advantages over the
+   FULL padded [b, R] tensor (trlx/model/accelerate_ppo_model.py:100 →
+   trlx/utils/modeling.py:5-11), so padded zeros pollute mean/var on ragged
+   batches; this repo whitens over valid tokens only (masked_whiten). Full-mask
+   cases therefore assert parity against the VERBATIM reference; ragged cases
+   assert parity against the reference with its `whiten` monkeypatched to the
+   mask-aware version ("corrected reference"), and additionally check that the
+   verbatim/corrected outputs genuinely differ (i.e. the deviation is real and
+   deliberate, not untested).
+2. Value indexing off-by-one. The reference stores rollout V at positions
+   [P-1, P+R-1) but its loss reads vpred at [P, P+R)
+   (trlx/orchestrator/ppo_orchestrator.py:94-96 vs
+   trlx/model/accelerate_ppo_model.py:120). That is an orchestrator-side slice
+   choice, not loss arithmetic; both sides here are fed the same [b, R] slices
+   so the loss math itself is compared apples-to-apples.
+3. Terminal score placement (kl_penalty_rewards): the reference adds the score
+   at column R-1 even when the row terminated early
+   (trlx/orchestrator/ppo_orchestrator.py:101-104); this repo adds it at the
+   last VALID token. Parity is asserted on full-length rows where the two
+   agree, and the ragged deviation is asserted explicitly.
+"""
+
+import importlib
+import importlib.machinery
+import sys
+import types
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from trlx_tpu.ops.ilql_loss import ilql_loss
+from trlx_tpu.ops.modeling import logprobs_from_logits
+from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
+
+REFERENCE_ROOT = "/root/reference"
+
+_ref_cache = {}
+
+
+def _reference_modules():
+    """Import the reference's trainer modules with stubs for deps absent from
+    this image (deepspeed, wandb, torchtyping). The stubs only satisfy import
+    statements; none of their attributes participate in the loss arithmetic."""
+    if _ref_cache:
+        return _ref_cache["ppo"], _ref_cache["ilql"]
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)
+    inserted = []
+    for name in ("deepspeed", "wandb", "torchtyping"):
+        if name in sys.modules:
+            continue
+        m = types.ModuleType(name)
+        m.__spec__ = importlib.machinery.ModuleSpec(name, None)
+        sys.modules[name] = m
+        inserted.append(name)
+    sys.modules["deepspeed"].comm = SimpleNamespace(get_rank=lambda: 0)
+    sys.modules["deepspeed"].zero = SimpleNamespace()
+    sys.modules["wandb"].Histogram = object
+    sys.modules["wandb"].Table = object
+
+    class _TensorType:
+        def __class_getitem__(cls, item):
+            return cls
+
+    sys.modules["torchtyping"].TensorType = _TensorType
+    try:
+        _ref_cache["ppo"] = importlib.import_module("trlx.model.accelerate_ppo_model")
+        _ref_cache["ilql"] = importlib.import_module("trlx.model.accelerate_ilql_model")
+    finally:
+        # Un-stub: the imported reference modules keep their direct references,
+        # but a later bare `import wandb` elsewhere in this pytest process must
+        # fail with ImportError again (trlx_tpu/utils/logging.py gates on that),
+        # not resolve to an attribute-less stub.
+        for name in inserted:
+            sys.modules.pop(name, None)
+    return _ref_cache["ppo"], _ref_cache["ilql"]
+
+
+PAD = 0  # pad_token_id; valid tokens drawn from [1, V)
+
+PPO_HP = dict(gamma=0.99, lam=0.95, cliprange=0.2, cliprange_value=0.2, vf_coef=1.0)
+
+
+def _make_ppo_case(seed, b, P, R, V, lengths=None):
+    """Synthetic rollout batch. lengths[i] = valid response length of row i
+    (None → all full). Padded tails hold zeros / PAD ids exactly as the
+    reference's pad_sequence collation produces."""
+    rng = np.random.default_rng(seed)
+    queries = rng.integers(1, V, size=(b, P)).astype(np.int64)
+    responses = rng.integers(1, V, size=(b, R)).astype(np.int64)
+    old_logprobs = (rng.normal(size=(b, R)) * 0.3).astype(np.float32)
+    old_values = rng.normal(size=(b, R)).astype(np.float32)
+    rewards = rng.normal(size=(b, R)).astype(np.float32)
+    mask = np.ones((b, R), np.float32)
+    if lengths is not None:
+        for i, L in enumerate(lengths):
+            responses[i, L:] = PAD
+            old_logprobs[i, L:] = 0.0
+            old_values[i, L:] = 0.0
+            rewards[i, L:] = 0.0
+            mask[i, L:] = 0.0
+    logits = (rng.normal(size=(b, P + R, V)) * 0.7).astype(np.float32)
+    vpred_full = rng.normal(size=(b, P + R)).astype(np.float32)
+    return dict(
+        queries=queries,
+        responses=responses,
+        old_logprobs=old_logprobs,
+        old_values=old_values,
+        rewards=rewards,
+        mask=mask,
+        logits=logits,
+        vpred_full=vpred_full,
+    )
+
+
+def _reference_ppo(case, corrected_whiten=False):
+    """Run the reference's real `AcceleratePPOModel.loss` on the case; returns
+    (loss, grad_logits, grad_vpred_full) as numpy."""
+    ref_ppo, _ = _reference_modules()
+    logits_t = torch.tensor(case["logits"], requires_grad=True)
+    vpred_t = torch.tensor(case["vpred_full"], requires_grad=True)
+
+    model = object.__new__(ref_ppo.AcceleratePPOModel)
+    model.accelerator = SimpleNamespace(device="cpu")
+    model.config = SimpleNamespace(method=SimpleNamespace(**PPO_HP))
+    model.tokenizer = SimpleNamespace(pad_token_id=PAD)
+    model.model = lambda tokens, attention_mask, position_ids=None: (logits_t, None, vpred_t)
+
+    batch = SimpleNamespace(
+        query_tensors=torch.tensor(case["queries"]),
+        response_tensors=torch.tensor(case["responses"]),
+        logprobs=torch.tensor(case["old_logprobs"]),
+        values=torch.tensor(case["old_values"]),
+        rewards=torch.tensor(case["rewards"]),
+    )
+
+    saved_whiten = ref_ppo.whiten
+    if corrected_whiten:
+        mask_t = torch.tensor(case["mask"])
+
+        def masked_whiten_torch(adv):
+            n = mask_t.sum()
+            mean = (adv * mask_t).sum() / n
+            var = ((adv - mean).pow(2) * mask_t).sum() / (n - 1)  # ddof=1 = torch.var
+            return (adv - mean) * torch.rsqrt(var + 1e-8) * mask_t
+
+        ref_ppo.whiten = masked_whiten_torch
+    try:
+        loss, _stats = ref_ppo.AcceleratePPOModel.loss(model, batch)
+    finally:
+        ref_ppo.whiten = saved_whiten
+    loss.backward()
+    return (
+        float(loss.detach()),
+        logits_t.grad.numpy().copy(),
+        vpred_t.grad.numpy().copy(),
+    )
+
+
+def _ours_ppo(case):
+    """This repo's ppo_loss through the same logits→logprobs composition the
+    reference uses, so gradients are comparable at the logits leaf."""
+    R = case["responses"].shape[1]
+    tokens = jnp.asarray(np.concatenate([case["queries"], case["responses"]], axis=1))
+    old_logprobs = jnp.asarray(case["old_logprobs"])
+    old_values = jnp.asarray(case["old_values"])
+    rewards = jnp.asarray(case["rewards"])
+    mask = jnp.asarray(case["mask"])
+
+    def loss_fn(logits, vpred_full):
+        lp = logprobs_from_logits(logits[:, :-1], tokens[:, 1:])[:, -R:]
+        vp = vpred_full[:, -R:]
+        loss, _ = ppo_loss(lp, vp, old_logprobs, old_values, rewards, mask, **PPO_HP)
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        jnp.asarray(case["logits"]), jnp.asarray(case["vpred_full"])
+    )
+    return float(loss), np.asarray(grads[0]), np.asarray(grads[1])
+
+
+@pytest.mark.parametrize(
+    "seed,b,P,R,V",
+    [(0, 4, 5, 8, 13), (1, 2, 3, 16, 29), (2, 6, 7, 6, 11)],
+)
+def test_ppo_loss_parity_full_mask(seed, b, P, R, V):
+    """Full-length responses: VERBATIM reference parity — loss and both grads."""
+    case = _make_ppo_case(seed, b, P, R, V)
+    ref_loss, ref_gl, ref_gv = _reference_ppo(case, corrected_whiten=False)
+    our_loss, our_gl, our_gv = _ours_ppo(case)
+    np.testing.assert_allclose(our_loss, ref_loss, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gl, ref_gl, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gv, ref_gv, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "seed,b,P,R,V,lengths",
+    [
+        (3, 4, 5, 8, 13, [8, 5, 3, 1]),
+        (4, 3, 4, 12, 17, [12, 7, 2]),
+        (5, 5, 2, 6, 11, [6, 6, 4, 3, 5]),
+    ],
+)
+def test_ppo_loss_parity_ragged(seed, b, P, R, V, lengths):
+    """Ragged tails: parity vs the reference with mask-aware whitening (the
+    corrected form — see module docstring deviation #1), and evidence that the
+    verbatim form actually differs (so the deviation is real)."""
+    case = _make_ppo_case(seed, b, P, R, V, lengths=lengths)
+    ref_loss, ref_gl, ref_gv = _reference_ppo(case, corrected_whiten=True)
+    our_loss, our_gl, our_gv = _ours_ppo(case)
+    np.testing.assert_allclose(our_loss, ref_loss, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gl, ref_gl, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gv, ref_gv, rtol=1e-5, atol=1e-5)
+
+    verbatim_loss, _, _ = _reference_ppo(case, corrected_whiten=False)
+    assert abs(verbatim_loss - ref_loss) > 1e-7, (
+        "verbatim and corrected whitening agreed on a ragged batch — the "
+        "documented deviation would be vacuous"
+    )
+
+
+def test_kl_penalty_rewards_parity_full_length():
+    """kl_penalty_rewards vs the reference's reward assembly
+    (trlx/orchestrator/ppo_orchestrator.py:101-104) on full-length rows, where
+    the terminal-score placement conventions coincide."""
+    rng = np.random.default_rng(6)
+    b, R = 4, 9
+    lp = rng.normal(size=(b, R)).astype(np.float32)
+    rlp = rng.normal(size=(b, R)).astype(np.float32)
+    scores = rng.normal(size=(b,)).astype(np.float32)
+    kl_coef = 0.13
+
+    # reference arithmetic, verbatim:
+    lp_t, rlp_t = torch.tensor(lp), torch.tensor(rlp)
+    kls_t = lp_t - rlp_t
+    rewards_t = -kl_coef * kls_t
+    rewards_t[:, -1] += torch.tensor(scores)
+
+    mask = jnp.ones((b, R), jnp.float32)
+    rewards_j, kl_j = kl_penalty_rewards(
+        jnp.asarray(lp), jnp.asarray(rlp), mask, jnp.asarray(scores), jnp.asarray(kl_coef)
+    )
+    np.testing.assert_allclose(np.asarray(rewards_j), rewards_t.numpy(), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kl_j), kls_t.numpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_kl_penalty_rewards_terminal_deviation_ragged():
+    """Deviation #3 stated as an assertion: on an early-terminated row the
+    reference puts the score on the padded final column (masked out of its
+    loss); this repo puts it on the last valid token."""
+    b, R, L = 1, 6, 3
+    lp = jnp.zeros((b, R))
+    mask = jnp.zeros((b, R)).at[0, :L].set(1.0)
+    scores = jnp.asarray([5.0])
+    rewards, _ = kl_penalty_rewards(lp, lp, mask, scores, jnp.asarray(0.1))
+    rewards = np.asarray(rewards)
+    assert rewards[0, L - 1] == 5.0  # ours: last valid token
+    assert rewards[0, R - 1] == 0.0  # reference would have put it here
+
+
+# ---------------------------------------------------------------------------
+# ILQL
+
+
+ILQL_HP = dict(gamma=0.99, tau=0.7, cql_scale=0.1, awac_scale=1.0)
+
+
+def _make_ilql_case(seed, b, T, A, V, n_actions=None, two_qs=True):
+    """Synthetic ILQL batch. n_actions[i] = valid actions of row i (None → A).
+    Index/done/reward padding follows the reference collate (pad_sequence → 0)."""
+    rng = np.random.default_rng(seed)
+    input_ids = rng.integers(1, V, size=(b, T)).astype(np.int64)
+    attention_mask = np.ones((b, T), np.int64)
+    actions_ixs = np.zeros((b, A), np.int64)
+    dones = np.zeros((b, A + 1), np.int64)
+    rewards = np.zeros((b, A), np.float32)
+    for i in range(b):
+        n = A if n_actions is None else n_actions[i]
+        # distinct, increasing indices into input_ids[:, 1:] (length T-1)
+        ixs = np.sort(rng.choice(T - 1, size=n, replace=False))
+        actions_ixs[i, :n] = ixs
+        dones[i, : n + 1] = 1
+        dones[i, n] = 0  # terminal state
+        rewards[i, :n] = rng.normal(size=n)
+    n_heads = 2 if two_qs else 1
+    qs = [(rng.normal(size=(b, A, V)) * 0.5).astype(np.float32) for _ in range(n_heads)]
+    tqs = [(rng.normal(size=(b, A, V)) * 0.5).astype(np.float32) for _ in range(n_heads)]
+    vs = rng.normal(size=(b, A + 1, 1)).astype(np.float32)
+    logits = (rng.normal(size=(b, T, V)) * 0.7).astype(np.float32)
+    return dict(
+        input_ids=input_ids,
+        attention_mask=attention_mask,
+        actions_ixs=actions_ixs,
+        dones=dones,
+        rewards=rewards,
+        qs=qs,
+        tqs=tqs,
+        vs=vs,
+        logits=logits,
+        two_qs=two_qs,
+    )
+
+
+def _reference_ilql(case):
+    """Run the reference's real `AccelerateILQLModel.loss`; returns
+    (loss, grad_logits, [grad_q...], grad_vs)."""
+    _, ref_ilql = _reference_modules()
+    logits_t = torch.tensor(case["logits"], requires_grad=True)
+    qs_t = [torch.tensor(q, requires_grad=True) for q in case["qs"]]
+    tqs_t = [torch.tensor(q) for q in case["tqs"]]
+    vs_t = torch.tensor(case["vs"], requires_grad=True)
+
+    two_qs = case["two_qs"]
+    fwd_qs = tuple(qs_t) if two_qs else qs_t[0]
+    fwd_tqs = tuple(tqs_t) if two_qs else tqs_t[0]
+
+    model = object.__new__(ref_ilql.AccelerateILQLModel)
+    model.accelerator = SimpleNamespace(device="cpu")
+    model.params = SimpleNamespace(two_qs=two_qs, **ILQL_HP)
+    model.model = lambda **kw: (logits_t, fwd_qs, fwd_tqs, vs_t, None)
+
+    A = case["actions_ixs"].shape[1]
+    batch = SimpleNamespace(
+        input_ids=torch.tensor(case["input_ids"]),
+        attention_mask=torch.tensor(case["attention_mask"]),
+        rewards=torch.tensor(case["rewards"]),
+        states_ixs=torch.zeros((case["input_ids"].shape[0], A + 1), dtype=torch.long),
+        actions_ixs=torch.tensor(case["actions_ixs"]),
+        dones=torch.tensor(case["dones"]),
+    )
+    loss, _stats = ref_ilql.AccelerateILQLModel.loss(model, batch)
+    loss.backward()
+    return (
+        float(loss.detach()),
+        logits_t.grad.numpy().copy(),
+        [q.grad.numpy().copy() for q in qs_t],
+        vs_t.grad.numpy().copy(),
+    )
+
+
+def _ours_ilql(case):
+    input_ids = jnp.asarray(case["input_ids"])
+    attention_mask = jnp.asarray(case["attention_mask"])
+    actions_ixs = jnp.asarray(case["actions_ixs"])
+    rewards = jnp.asarray(case["rewards"])
+    dones = jnp.asarray(case["dones"])
+    tqs = tuple(jnp.asarray(q) for q in case["tqs"])
+
+    def loss_fn(logits, qs, vs3):
+        loss, _ = ilql_loss(
+            logits,
+            tuple(qs),
+            tqs,
+            vs3[..., 0],
+            input_ids,
+            attention_mask,
+            actions_ixs,
+            rewards,
+            dones,
+            **ILQL_HP,
+        )
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+        jnp.asarray(case["logits"]),
+        [jnp.asarray(q) for q in case["qs"]],
+        jnp.asarray(case["vs"]),
+    )
+    return (
+        float(loss),
+        np.asarray(grads[0]),
+        [np.asarray(g) for g in grads[1]],
+        np.asarray(grads[2]),
+    )
+
+
+@pytest.mark.parametrize(
+    "seed,b,T,A,V,n_actions,two_qs",
+    [
+        (10, 4, 10, 6, 13, None, True),          # full actions, double-Q
+        (11, 3, 12, 8, 17, [8, 5, 2], True),     # ragged actions, double-Q
+        (12, 2, 9, 5, 11, [5, 3], False),        # ragged, single-Q
+        (13, 5, 8, 4, 23, [4, 4, 2, 1, 3], True),
+    ],
+)
+def test_ilql_loss_parity(seed, b, T, A, V, n_actions, two_qs):
+    """Loss + gradients at every differentiable leaf (logits, each online Q
+    head, V head) match the reference's own torch implementation to 1e-5."""
+    case = _make_ilql_case(seed, b, T, A, V, n_actions=n_actions, two_qs=two_qs)
+    ref_loss, ref_gl, ref_gq, ref_gv = _reference_ilql(case)
+    our_loss, our_gl, our_gq, our_gv = _ours_ilql(case)
+    np.testing.assert_allclose(our_loss, ref_loss, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gl, ref_gl, rtol=1e-5, atol=1e-5)
+    for og, rg in zip(our_gq, ref_gq):
+        np.testing.assert_allclose(og, rg, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(our_gv, ref_gv, rtol=1e-5, atol=1e-5)

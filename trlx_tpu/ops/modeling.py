@@ -15,9 +15,11 @@ from jax import nn as jnn
 
 def whiten(values: jnp.ndarray, shift_mean: bool = True) -> jnp.ndarray:
     """Normalize to zero mean / unit variance
-    (reference: trlx/utils/modeling.py:5-11)."""
+    (reference: trlx/utils/modeling.py:5-11). Unbiased (ddof=1) variance to
+    match torch.var's default — verified to 1e-5 (loss and gradients) against
+    the reference's own code in tests/test_reference_parity.py."""
     mean = jnp.mean(values)
-    var = jnp.var(values)
+    var = jnp.var(values, ddof=1)
     whitened = (values - mean) * jnp.reciprocal(jnp.sqrt(var + 1e-8))
     if not shift_mean:
         whitened = whitened + mean
@@ -30,10 +32,13 @@ def masked_mean(values: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarra
     return jnp.sum(values * mask, axis=axis) / jnp.maximum(jnp.sum(mask, axis=axis), 1e-8)
 
 
-def masked_var(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Variance over positions where mask == 1."""
+def masked_var(values: jnp.ndarray, mask: jnp.ndarray, ddof: int = 1) -> jnp.ndarray:
+    """Variance over positions where mask == 1 (unbiased by default, matching
+    torch.var as used by the reference's whiten)."""
+    mask = mask.astype(values.dtype)
     mean = masked_mean(values, mask)
-    return masked_mean(jnp.square(values - mean), mask)
+    sq = jnp.sum(jnp.square(values - mean) * mask)
+    return sq / jnp.maximum(jnp.sum(mask) - ddof, 1e-8)
 
 
 def masked_whiten(values: jnp.ndarray, mask: jnp.ndarray, shift_mean: bool = True) -> jnp.ndarray:
