@@ -412,3 +412,31 @@ def test_offline_orchestrator_degenerate_samples(task):
     samples = [np.asarray([3]), np.asarray(walks[0]), np.asarray(walks[1])]
     orch.make_experience(samples, [0.5, 1.0, -1.0])
     assert len(model.store) == 3
+
+
+def test_kl_controller_trajectory_invariant_to_log_interval(task, tmp_path):
+    """The adaptive KL controller buffers every step's mean_kl and applies
+    per-step updates in order, so its final coefficient is IDENTICAL for
+    log_interval 1 and 4 on the same seeds/data (it used to react only to
+    every Nth step's KL with a rescaled step count)."""
+
+    def run(log_interval, ckpt_dir):
+        walks, logit_mask, metric_fn, reward_fn = task
+        config = shrink(base_config("ppo", 15, 8))
+        config.train.checkpoint_dir = str(ckpt_dir)
+        config.train.total_steps = 5
+        config.train.log_interval = log_interval
+        config.train.eval_interval = 100
+        assert config.method.target is not None  # adaptive controller in play
+        prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+        model = trlx_tpu.train(
+            reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+            metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+        )
+        model._flush_kl_updates()
+        return model.kl_ctl.value
+
+    v1 = run(1, tmp_path / "a")
+    v4 = run(4, tmp_path / "b")
+    assert v1 != pytest.approx(0.05), "controller never moved — test is vacuous"
+    assert v4 == pytest.approx(v1, rel=1e-6)

@@ -71,16 +71,21 @@ def _reference_modules():
         m.__spec__ = importlib.machinery.ModuleSpec(name, None)
         sys.modules[name] = m
         inserted.append(name)
-    sys.modules["deepspeed"].comm = SimpleNamespace(get_rank=lambda: 0)
-    sys.modules["deepspeed"].zero = SimpleNamespace()
-    sys.modules["wandb"].Histogram = object
-    sys.modules["wandb"].Table = object
+    # Only flesh out modules WE inserted — if a real wandb/deepspeed is
+    # installed and already imported, it must not be clobbered.
+    if "deepspeed" in inserted:
+        sys.modules["deepspeed"].comm = SimpleNamespace(get_rank=lambda: 0)
+        sys.modules["deepspeed"].zero = SimpleNamespace()
+    if "wandb" in inserted:
+        sys.modules["wandb"].Histogram = object
+        sys.modules["wandb"].Table = object
+    if "torchtyping" in inserted:
 
-    class _TensorType:
-        def __class_getitem__(cls, item):
-            return cls
+        class _TensorType:
+            def __class_getitem__(cls, item):
+                return cls
 
-    sys.modules["torchtyping"].TensorType = _TensorType
+        sys.modules["torchtyping"].TensorType = _TensorType
     try:
         _ref_cache["ppo"] = importlib.import_module("trlx.model.accelerate_ppo_model")
         _ref_cache["ilql"] = importlib.import_module("trlx.model.accelerate_ilql_model")

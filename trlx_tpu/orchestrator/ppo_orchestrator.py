@@ -75,7 +75,14 @@ class PPOOrchestrator(Orchestrator):
             tokens, mask, P = pending
             # Rows THIS process will store (num_rollouts is per-process, the
             # reference's per-rank semantics). Static shape — no device sync.
-            chunk_rows = int(tokens.shape[0]) // jax.process_count()
+            n_proc = jax.process_count()
+            if int(tokens.shape[0]) % n_proc != 0 or int(tokens.shape[0]) < n_proc:
+                raise ValueError(
+                    f"rollout chunk of {int(tokens.shape[0])} rows does not divide "
+                    f"evenly over {n_proc} processes — pick a chunk_size that is a "
+                    "positive multiple of the process count"
+                )
+            chunk_rows = int(tokens.shape[0]) // n_proc
             need_more = n_collected + chunk_rows < num_rollouts
             if need_more:
                 pending = self._generate_next_chunk()

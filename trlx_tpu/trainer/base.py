@@ -13,6 +13,7 @@ reference delegates to Accelerate/DeepSpeed is explicit here:
 """
 
 import os
+import sys
 import time
 from abc import abstractmethod
 from typing import Any, Callable, Optional
@@ -273,10 +274,40 @@ class JaxBaseTrainer(BaseRLTrainer):
         """Return jitted train_step(state, batch, *extra) -> (state, stats)."""
 
     def post_backward_callback(self, stats=None):
-        pass
+        """Called after EVERY optimizer step with the step's stats dict.
+        The values are un-fetched device scalars — implementations must not
+        force a sync on the hot path (buffer, then read at a log boundary)."""
 
     def post_epoch_callback(self):
         pass
+
+    def progress_line(self, stats_host: dict):
+        """Rank-0 live progress line on stderr at each logged step — the
+        counterpart of the reference's tqdm bar with stats description
+        (reference: trlx/model/accelerate_base_model.py:210-248). A plain
+        carriage-return-rewritten line: no tqdm dependency, degrades to one
+        line per log step when stderr is a file."""
+        if not is_main_process() or os.environ.get("TRLX_TPU_NO_PROGRESS"):
+            return
+        parts = [f"step {self.iter_count}/{self.total_steps}"]
+        for key, label in (
+            ("loss", "loss"),
+            ("mean_reward", "reward"),
+            ("mean_kl", "kl"),
+            ("metrics/optimality", "optimality"),
+            ("samples_per_sec", "samples/s"),
+        ):
+            if key in stats_host:
+                parts.append(f"{label}={stats_host[key]:.4g}")
+        print("  ".join(parts) + " " * 8, end="\r", file=sys.stderr, flush=True)
+        self._progress_open = True
+
+    def end_progress(self):
+        """Terminate an open \\r-rewritten progress line so subsequent output
+        (eval tables, tracebacks) doesn't print over its remnants."""
+        if getattr(self, "_progress_open", False):
+            print(file=sys.stderr, flush=True)
+            self._progress_open = False
 
     @abstractmethod
     def prepare_learning(self):
@@ -322,10 +353,26 @@ class JaxBaseTrainer(BaseRLTrainer):
         wrap-around duplicates are dropped before means/tables. With an
         on-device reward model (and no host reward_fn), eval rewards come
         from the RM."""
+        self.end_progress()
         stats = {}
         all_texts = []
         rm_scores = []
         use_rm = self.reward_fn is None and getattr(self, "has_reward_model", False)
+        if jax.process_count() > 1:
+            # The loop below runs collectives per batch — if per-process eval
+            # pipelines held different row counts, processes would iterate
+            # different batch counts and deadlock in the gather. Fail loudly
+            # up front instead.
+            from trlx_tpu.parallel.mesh import allgather_host
+
+            counts = allgather_host(
+                np.asarray([len(self.eval_dataloader)], dtype=np.int32)
+            ).reshape(-1)
+            if len(set(int(c) for c in counts)) != 1:
+                raise RuntimeError(
+                    f"eval dataloader length differs across processes: {counts.tolist()} "
+                    "— every host must hold the same number of eval batches"
+                )
         clock = Clock()
         for batch, n_valid in self.eval_dataloader.iter_with_valid():
             tokens, mask = self.rollout_generate(batch["input_ids"], batch["attention_mask"])
@@ -348,7 +395,9 @@ class JaxBaseTrainer(BaseRLTrainer):
         elif self.reward_fn is not None:
             t0 = time.time()
             rewards = np.asarray(self.reward_fn(all_texts), dtype=np.float32)
-            stats["metric_time"] = time.time() - t0
+            # own key — metric_fn below logs "metric_time" and must not
+            # clobber (or be clobbered by) the reward timing
+            stats["reward_time"] = time.time() - t0
         if rewards is not None:
             stats["mean_reward"] = float(np.mean(rewards))
             columns.append("reward")
@@ -431,6 +480,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         try:
             return self._learn_loop(profiler_tick)
         finally:
+            self.end_progress()
             if self._profiling:
                 jax.profiler.stop_trace()
             if handler_installed:
@@ -474,6 +524,13 @@ class JaxBaseTrainer(BaseRLTrainer):
                     self.state, stats = self.train_step(self.state, device_batch)
                     self.iter_count += 1
 
+                    # Every step gets the DEVICE stats dict (async, no sync):
+                    # subclasses buffer what they need (the adaptive KL
+                    # controller queues each step's mean_kl scalar and applies
+                    # the per-step updates at its next flush, so log_interval
+                    # no longer blinds or rescales the controller).
+                    self.post_backward_callback(stats)
+
                     intervals = self.intervals(self.iter_count)
                     if intervals["do_checkpoint"]:
                         self.save()
@@ -483,19 +540,16 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # accelerator.log, reference:
                         # trlx/model/accelerate_base_model.py:244). With
                         # log_interval > 1 the device queue stays full
-                        # between logs; the adaptive KL controller then also
-                        # updates only on logged steps.
+                        # between logs.
                         stats_host = {k: float(v) for k, v in stats.items()}
                         if intervals["do_eval"]:
                             stats_host.update(self.evaluate())
-                        self.tracker.log(stats_host, step=self.iter_count)
                         stats_host["step_time"] = time.time() - forward_t0
                         stats_host["samples_per_sec"] = (
                             self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
                         )
-                        self.post_backward_callback(stats_host)
-                    else:
-                        self.post_backward_callback(None)
+                        self.tracker.log(stats_host, step=self.iter_count)
+                        self.progress_line(stats_host)
 
                     # Mid-batch reaction stays single-process-only: a
                     # per-step agreement collective would tax the hot loop,

@@ -67,6 +67,10 @@ class PPOTrainer(JaxBaseTrainer):
             self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
         else:
             self.kl_ctl = FixedKLController(m.init_kl_coef)
+        # Per-step mean_kl device scalars queued by post_backward_callback;
+        # flushed (fetched + applied in order) at log boundaries and before
+        # any consumer of kl_ctl.value — see _flush_kl_updates.
+        self._kl_pending = []
         # Resume happened in the base __init__, before kl_ctl existed —
         # re-apply the buffered host state now that it does.
         resumed = getattr(self, "loaded_host_state", None)
@@ -181,7 +185,10 @@ class PPOTrainer(JaxBaseTrainer):
         vals = out["values"].astype(jnp.float32)  # [b, T]
         B, T = tokens.shape
         last_ix = T - 1 - jnp.argmax(mask[:, ::-1].astype(jnp.int32), axis=-1)
-        return vals[jnp.arange(B), last_ix]
+        # An all-padding row would index T-1 (argmax of all-zeros is 0) and
+        # read a reward from an arbitrary position — zero its score instead.
+        has_valid = (jnp.sum(mask, axis=-1) > 0).astype(jnp.float32)
+        return vals[jnp.arange(B), last_ix] * has_valid
 
     def _rollout_score_rm_impl(self, params, extras, rm_params, tokens, mask, kl_coef, *, prompt_length: int):
         scores = self._rm_scores(rm_params, tokens, mask)
@@ -305,11 +312,6 @@ class PPOTrainer(JaxBaseTrainer):
 
         return jax.jit(train_step, donate_argnums=(0,))
 
-    def host_state_dict(self) -> dict:
-        d = super().host_state_dict()
-        d["kl_coef"] = float(self.kl_ctl.value)
-        return d
-
     def load_host_state(self, d: dict):
         super().load_host_state(d)
         if "kl_coef" in d and hasattr(self, "kl_ctl"):
@@ -318,20 +320,41 @@ class PPOTrainer(JaxBaseTrainer):
     # ------------------------------------------------------------- callbacks
 
     def post_backward_callback(self, stats=None):
-        """KL-coefficient update from the policy-vs-rollout KL
-        (reference: trlx/model/accelerate_ppo_model.py:163-165). With
-        log_interval > 1 the callback sees stats only every Nth step, so
-        n_steps scales by N to keep the adaptation rate invariant to the
-        logging cadence."""
+        """Queue this step's policy-vs-rollout mean_kl for the adaptive
+        controller (reference: trlx/model/accelerate_ppo_model.py:163-165).
+
+        The value arrives as an un-fetched device scalar — appending costs
+        nothing on the hot path. The controller applies the buffered per-step
+        updates in order at the next flush, so its trajectory is EXACTLY the
+        per-step (log_interval == 1) trajectory regardless of logging cadence
+        (tests/test_e2e.py::test_kl_controller_trajectory_invariant_to_log_interval).
+        kl_ctl.value is only ever consumed at a rollout or checkpoint, and
+        both flush first."""
+        if isinstance(self.kl_ctl, FixedKLController):
+            return  # no-op controller: don't buy device syncs for nothing
         if stats and "mean_kl" in stats:
-            self.kl_ctl.update(
-                stats["mean_kl"],
-                self.config.train.batch_size * self.config.train.log_interval,
-            )
+            self._kl_pending.append(stats["mean_kl"])
+            # Keep the buffer (and the retained device scalars) bounded.
+            if len(self._kl_pending) >= max(self.config.train.log_interval, 8):
+                self._flush_kl_updates()
+
+    def _flush_kl_updates(self):
+        if not self._kl_pending:
+            return
+        pending, self._kl_pending = self._kl_pending, []
+        for v in jax.device_get(pending):
+            self.kl_ctl.update(float(v), self.config.train.batch_size)
+
+    def host_state_dict(self) -> dict:
+        self._flush_kl_updates()
+        d = super().host_state_dict()
+        d["kl_coef"] = float(self.kl_ctl.value)
+        return d
 
     def post_epoch_callback(self):
         """Alternate back to rollout
         (reference: trlx/model/accelerate_ppo_model.py:157-161)."""
+        self._flush_kl_updates()  # rollout rewards consume kl_ctl.value
         self.store.clear_history()
         self.orch.make_experience(self.config.method.num_rollouts, self.iter_count)
         self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
