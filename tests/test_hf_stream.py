@@ -1,0 +1,276 @@
+"""Streamed (per-tensor, torch-free) safetensors loading: the pod-scale load
+path. Covers the exact on-disk format a real 6B/20B download has — multiple
+shards + model.safetensors.index.json, fp16/bf16 tensors — plus the
+O(largest-tensor) memory discipline that replaces the capability the
+reference gets from DeepSpeed zero3_init
+(reference: trlx/model/nn/ilql_models.py:39-45)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from trlx_tpu.models import TransformerLM
+from trlx_tpu.models.hf_import import (
+    LazySafetensors,
+    lm_config_from_hf,
+    load_hf_trunk,
+    make_stream_put,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _save_sharded_mixed_dtype(model, out_dir, n_shards=3):
+    """Write the model's state dict as n_shards safetensors files + an
+    index.json — the exact layout of a real multi-shard HF download — with
+    mixed tensor dtypes (fp16 / bf16 / fp32 round-robin by shard)."""
+    from safetensors.torch import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    sd = {k: v.detach().clone() for k, v in model.state_dict().items()}
+    keys = list(sd)
+    dtypes = [torch.float16, torch.bfloat16, torch.float32]
+    weight_map = {}
+    for s in range(n_shards):
+        fname = f"model-{s + 1:05d}-of-{n_shards:05d}.safetensors"
+        shard = {}
+        for k in keys[s::n_shards]:
+            shard[k] = sd[k].to(dtypes[s % len(dtypes)]).contiguous()
+            weight_map[k] = fname
+        save_file(shard, os.path.join(out_dir, fname))
+    with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f)
+    return weight_map
+
+
+def test_sharded_mixed_dtype_load_logits_parity(tmp_path):
+    """3-shard fp16/bf16/fp32 checkpoint → streamed load → logits match a
+    torch forward over the SAME rounded weights to fp32 tolerance."""
+    config = transformers.GPTJConfig(
+        n_layer=3, n_head=4, n_embd=64, vocab_size=128, n_positions=64, rotary_dim=8
+    )
+    hf_model = transformers.GPTJForCausalLM(config)
+    ckpt = str(tmp_path / "ckpt")
+    _save_sharded_mixed_dtype(hf_model, ckpt, n_shards=3)
+    assert os.path.exists(os.path.join(ckpt, "model.safetensors.index.json"))
+
+    # torch reference: reload the rounded weights fp32 (load_state_dict casts)
+    sd = LazySafetensors(ckpt)
+    rounded = {k: torch.as_tensor(np.asarray(sd[k]).astype(np.float32)) for k in sd.keys()}
+    hf_model.load_state_dict(rounded)
+    hf_model.eval()
+
+    cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
+    trunk = load_hf_trunk(ckpt, cfg, put=lambda path, arr: jnp.asarray(np.asarray(arr, np.float32)))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.as_tensor(ids)).logits.numpy()
+    model = TransformerLM(cfg)
+    out = model.apply({"params": trunk}, jnp.asarray(ids), jnp.ones(ids.shape, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out["logits"], np.float32), ref, atol=2e-4, rtol=1e-3)
+
+
+def test_single_file_safetensors_load(tmp_path):
+    """save_pretrained's single model.safetensors file (no index) streams
+    through the same lazy path."""
+    config = transformers.GPT2Config(n_layer=2, n_head=4, n_embd=64, vocab_size=128, n_positions=64)
+    hf_model = transformers.GPT2LMHeadModel(config)
+    ckpt = str(tmp_path / "single")
+    hf_model.save_pretrained(ckpt, safe_serialization=True)
+    assert os.path.exists(os.path.join(ckpt, "model.safetensors"))
+
+    hf_model.eval()
+    cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
+    trunk = load_hf_trunk(ckpt, cfg)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 10))
+    with torch.no_grad():
+        ref = hf_model(torch.as_tensor(ids)).logits.numpy()
+    model = TransformerLM(cfg)
+    out = model.apply({"params": trunk}, jnp.asarray(ids), jnp.ones(ids.shape, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out["logits"], np.float32), ref, atol=2e-4, rtol=1e-3)
+
+
+def test_export_roundtrip_through_streamed_loader(tmp_path):
+    """hf_export's safetensors output re-imports through the streamed loader
+    bit-exactly (fp32): our export → our lazy import closes the loop."""
+    from trlx_tpu.models.hf_export import export_hf
+    from trlx_tpu.models.lm import LMConfig
+    import jax
+
+    cfg = LMConfig.from_dict(
+        dict(
+            vocab_size=97, n_layer=2, n_head=4, d_model=32, max_position=64,
+            pos_type="rotary", rotary_dim=8, parallel_residual=True,
+            use_parallel_ln=False, fused_qkv=False, qkv_bias=False,
+            out_bias=False, tie_word_embeddings=False, activation="gelu_new",
+            extra={"lm_head_bias": True},
+        )
+    )
+    model = TransformerLM(cfg)
+    ids = jnp.zeros((1, 4), jnp.int32)
+    # bare TransformerLM: its params ARE the trunk (no "transformer" wrapper)
+    params = model.init(jax.random.PRNGKey(0), ids, jnp.ones_like(ids))["params"]
+    out_dir = str(tmp_path / "export")
+    export_hf(params, cfg, out_dir, family="gptj")
+
+    trunk = load_hf_trunk(out_dir, cfg)
+    ref_leaves, ref_tree = jax.tree_util.tree_flatten(params)
+    got_leaves, got_tree = jax.tree_util.tree_flatten(trunk)
+    assert ref_tree == got_tree
+    for a, b in zip(ref_leaves, got_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stream_put_shards_on_mesh(tmp_path):
+    """make_stream_put places each tensor against the lm partition rules on
+    the live mesh as it is converted — the tensors arrive sharded, never
+    resident as a full host tree."""
+    import jax
+    from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP, make_mesh, set_mesh
+
+    config = transformers.GPT2Config(n_layer=2, n_head=4, n_embd=64, vocab_size=128, n_positions=64)
+    hf_model = transformers.GPT2LMHeadModel(config)
+    ckpt = str(tmp_path / "mesh_ckpt")
+    hf_model.save_pretrained(ckpt, safe_serialization=True)
+
+    mesh = make_mesh((2, 2, 2, 1))  # dp=2 fsdp=2 tp=2
+    set_mesh(mesh)
+    try:
+        cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
+        model = TransformerLM(cfg)
+        dummy = jnp.zeros((1, 2), jnp.int32)
+        init = model.init(jax.random.PRNGKey(0), dummy, jnp.ones_like(dummy))["params"]
+        trunk = load_hf_trunk(ckpt, cfg, put=make_stream_put(init))
+        qkv = trunk["h_0"]["attn"]["c_qkv"]["kernel"]
+        assert isinstance(qkv, jax.Array)
+        spec = qkv.sharding.spec  # column-parallel: [d_model(fsdp), 3d(tp)]
+        assert tuple(spec) == (AXIS_FSDP, AXIS_TP)
+        ln = trunk["h_0"]["ln_1"]["scale"]
+        assert tuple(ln.sharding.spec) in ((), (None,))  # replicated
+    finally:
+        set_mesh(make_mesh((-1, 1, 1, 1)))
+
+
+MEMORY_PROBE = r"""
+import json, os, sys, tracemalloc
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+ckpt = sys.argv[2]
+
+from trlx_tpu.models.hf_import import load_hf_trunk
+from trlx_tpu.models.lm import LMConfig
+
+with open(os.path.join(ckpt, "lm_config.json")) as f:
+    cfg = LMConfig.from_dict(json.load(f))
+
+seen = {"bytes": 0, "count": 0, "largest": 0}
+
+def discard_put(path, arr):
+    # emulates the pod path: the tensor leaves host RAM for device HBM
+    seen["bytes"] += arr.nbytes
+    seen["count"] += 1
+    seen["largest"] = max(seen["largest"], arr.nbytes)
+    return np.zeros((), np.float32)
+
+tracemalloc.start()
+load_hf_trunk(ckpt, cfg, put=discard_put)
+_, peak = tracemalloc.get_traced_memory()
+tracemalloc.stop()
+print(json.dumps({"peak": peak, **seen}))
+"""
+
+
+def test_streamed_load_memory_is_o_largest_tensor(tmp_path):
+    """Peak heap during a multi-shard load stays O(largest tensor) — NOT
+    O(model). A ~90 MB 4-shard checkpoint with a 16 MB largest tensor must
+    load (tensors discarded as a stand-in for device placement) within ~3×
+    the largest tensor of traced allocations (transpose + cast temporaries)."""
+    from safetensors.numpy import save_file
+
+    # gpt2-family synthetic arch: wte [8192, 512] fp32 = 16 MB is the largest
+    n_layer, d, vocab = 8, 512, 8192
+    cfg_dict = dict(
+        vocab_size=vocab, n_layer=n_layer, n_head=8, d_model=d,
+        max_position=128, pos_type="learned", parallel_residual=False,
+        fused_qkv=True, qkv_bias=True, tie_word_embeddings=True,
+        activation="gelu_new",
+    )
+    ckpt = str(tmp_path / "big")
+    os.makedirs(ckpt)
+    rng = np.random.default_rng(0)
+
+    def t(*shape):
+        return rng.standard_normal(size=shape).astype(np.float32)
+
+    weight_map = {}
+    shard, shard_id, shard_bytes = {}, 1, 0
+
+    def flush(final=False):
+        nonlocal shard, shard_id, shard_bytes
+        if not shard:
+            return
+        fname = f"model-{shard_id:05d}.safetensors"
+        save_file(shard, os.path.join(ckpt, fname))
+        for k in shard:
+            weight_map[k] = fname
+        shard, shard_bytes = {}, 0
+        shard_id += 1
+
+    def add(key, arr):
+        nonlocal shard_bytes
+        shard[key] = arr
+        shard_bytes += arr.nbytes
+        if shard_bytes > 24e6:
+            flush()
+
+    add("transformer.wte.weight", t(vocab, d))
+    add("transformer.wpe.weight", t(128, d))
+    for i in range(n_layer):
+        h = f"transformer.h.{i}"
+        add(f"{h}.ln_1.weight", t(d)); add(f"{h}.ln_1.bias", t(d))
+        add(f"{h}.ln_2.weight", t(d)); add(f"{h}.ln_2.bias", t(d))
+        add(f"{h}.attn.c_attn.weight", t(d, 3 * d)); add(f"{h}.attn.c_attn.bias", t(3 * d))
+        add(f"{h}.attn.c_proj.weight", t(d, d)); add(f"{h}.attn.c_proj.bias", t(d))
+        add(f"{h}.mlp.c_fc.weight", t(d, 4 * d)); add(f"{h}.mlp.c_fc.bias", t(4 * d))
+        add(f"{h}.mlp.c_proj.weight", t(4 * d, d)); add(f"{h}.mlp.c_proj.bias", t(d))
+    add("transformer.ln_f.weight", t(d)); add("transformer.ln_f.bias", t(d))
+    flush(final=True)
+    with open(os.path.join(ckpt, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f)
+    with open(os.path.join(ckpt, "lm_config.json"), "w") as f:
+        json.dump(cfg_dict, f)
+
+    probe = str(tmp_path / "probe.py")
+    with open(probe, "w") as f:
+        f.write(MEMORY_PROBE)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, probe, REPO, ckpt],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    largest = rep["largest"]
+    total = rep["bytes"]
+    assert largest == vocab * d * 4  # wte is the largest tensor
+    assert total > 5 * largest  # the model is much bigger than one tensor
+    # The streaming claim: peak heap ~ a few transpose/cast temporaries of
+    # ONE tensor, not the whole model.
+    assert rep["peak"] < 3 * largest + 8e6, (
+        f"peak heap {rep['peak']/1e6:.1f} MB vs largest tensor {largest/1e6:.1f} MB "
+        f"(model total {total/1e6:.1f} MB) — load is not streaming"
+    )

@@ -1,16 +1,33 @@
-"""HF checkpoint → trlx_tpu param pytree conversion.
+"""HF checkpoint → trlx_tpu param pytree conversion, STREAMED per tensor.
 
 The reference builds models with AutoModelForCausalLM.from_pretrained
-(reference: trlx/model/nn/ppo_models.py:322-325). Here HF is only a WEIGHT
-SOURCE: torch state dicts are converted once, on host, into our Flax layout;
-the TPU program never touches torch. Supported families match the reference's
-(reference: README.md:6): gpt2, gpt-j, gpt-neo, gpt-neox. With no checkpoint (or
-`model_arch` given) params initialize from scratch — the randomwalks path
+(reference: trlx/model/nn/ppo_models.py:322-325) — the full torch module in
+host RAM (~80 GB/host for NeoX-20B fp32, twice that while both module and
+converted copies are alive), which it papers over with DeepSpeed's zero3_init
+(reference: trlx/model/nn/ilql_models.py:39-45). Here HF is only a WEIGHT
+SOURCE and the load is TPU-native streaming:
+
+- the conversion layout is a SPEC tree (one thunk per target leaf), so
+  materialization is per-tensor;
+- safetensors checkpoints (single-file or index.json-sharded) are read
+  lazily and torch-free (`safe_open(framework="np")` handles fp16/bf16);
+- each converted tensor is cast to its target dtype and `device_put`
+  against its partition spec IMMEDIATELY — peak host memory is O(largest
+  tensor), not O(model). On a pod every host streams the same file and
+  contributes its addressable shards (jax.make_array_from_callback).
+
+Legacy pytorch_model.bin checkpoints fall back to the full torch load.
+Supported families match the reference's (reference: README.md:6): gpt2,
+gpt-j, gpt-neo, gpt-neox. With no checkpoint (or `model_arch` given) params
+initialize from scratch — the randomwalks path
 (reference: examples/randomwalks.py:99-101).
 """
 
-from typing import Any, Dict
+import json
+import os
+from typing import Any, Callable, Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -121,35 +138,166 @@ def lm_config_from_hf(hf, **overrides) -> LMConfig:
 def load_or_init_params(model, config, rng) -> Dict[str, Any]:
     """Initialize params; when a checkpoint is available, splice converted HF
     trunk weights over the fresh init (heads stay fresh, like the reference's
-    newly-initialized value/Q heads, reference: trlx/model/nn/ppo_models.py:333)."""
+    newly-initialized value/Q heads, reference: trlx/model/nn/ppo_models.py:333).
+
+    Pod-scale discipline end to end: with a checkpoint AND a multi-device
+    mesh, the fresh init is jitted with sharded out_shardings (params are
+    BORN distributed — no host copy of the full tree ever exists) and the
+    trunk then streams over it tensor-by-tensor via make_stream_put. Peak
+    per-host memory is O(model/n_devices) for the resident shards plus
+    O(largest tensor) for the stream — never O(model)."""
+    from trlx_tpu.parallel.mesh import peek_mesh
+
     cfg = model.cfg
     dummy = jnp.zeros((1, 2), dtype=jnp.int32)
-    params = model.init(rng, dummy, jnp.ones_like(dummy))["params"]
+    mesh = peek_mesh()
+    multi_device = mesh is not None and int(np.prod(list(mesh.shape.values()))) > 1
+
+    def init_fn(r):
+        return model.init(r, dummy, jnp.ones_like(dummy))["params"]
+
+    if multi_device:
+        abstract = jax.eval_shape(init_fn, rng)
+        shardings = _tree_shardings(mesh, abstract)
+        params = jax.jit(init_fn, out_shardings=shardings)(rng)
+    else:
+        params = init_fn(rng)
     mc = config.model
     if mc.model_path and not mc.model_arch:
-        trunk = load_hf_trunk(mc.model_path, cfg)
+        put = make_stream_put(params["transformer"])
+        trunk = load_hf_trunk(mc.model_path, cfg, put=put)
         params = {**params, "transformer": trunk}
     return params
 
 
-def load_hf_trunk(model_path: str, cfg: LMConfig) -> Dict[str, Any]:
-    """Load an HF torch checkpoint and convert the transformer trunk."""
-    import torch  # host-only
-    from transformers import AutoModelForCausalLM
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
-    hf_model = AutoModelForCausalLM.from_pretrained(model_path, torch_dtype=torch.float32)
-    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
-    del hf_model
+
+def _tree_shardings(mesh, abstract_tree):
+    """NamedShardings for an abstract (eval_shape) param tree via the shared
+    lm partition rules + sanitize (works on ShapeDtypeStructs: only .shape
+    and .ndim are consulted)."""
+    from trlx_tpu.parallel.sharding import (
+        lm_partition_rules,
+        match_partition_rules,
+        sanitize_specs,
+        specs_to_shardings,
+    )
+
+    specs = sanitize_specs(
+        mesh, abstract_tree, match_partition_rules(lm_partition_rules(), abstract_tree)
+    )
+    return specs_to_shardings(mesh, specs)
+
+
+def make_stream_put(init_trunk) -> Callable[[str, np.ndarray], Any]:
+    """Per-tensor placement hook for the streamed load.
+
+    Casts each converted tensor to the dtype of the matching init leaf (the
+    flax module's param_dtype), then — when a process-global mesh exists —
+    builds the GLOBAL sharded array for that leaf's partition spec via
+    make_array_from_callback: every host reads the full tensor from disk and
+    contributes its addressable shards, so nothing larger than one tensor is
+    ever resident per host. Sharding specs come from the shared lm partition
+    rules (match_partition_rules + sanitize_specs — one source of truth with
+    shard_pytree)."""
+    from trlx_tpu.parallel.mesh import peek_mesh
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(init_trunk)
+    dtypes = {_path_str(p): l.dtype for p, l in flat}
+    mesh = peek_mesh()
+    shardings_by_path: Dict[str, Any] = {}
+    if mesh is not None and int(np.prod(list(mesh.shape.values()))) > 1:
+        sh = _tree_shardings(mesh, init_trunk)
+        flat_sh, _ = jax.tree_util.tree_flatten_with_path(
+            sh, is_leaf=lambda x: hasattr(x, "spec")
+        )
+        shardings_by_path = {_path_str(p): s for p, s in flat_sh}
+
+    def put(path: str, arr: np.ndarray):
+        target = dtypes.get(path)
+        if target is not None and arr.dtype != target:
+            arr = np.asarray(arr).astype(target)
+        sharding = shardings_by_path.get(path)
+        if sharding is None:
+            return jnp.asarray(arr)
+        return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+    return put
+
+
+class LazySafetensors:
+    """Per-tensor lazy mapping over a safetensors checkpoint directory —
+    single-file (model.safetensors) or sharded
+    (model-0000X-of-0000N.safetensors + model.safetensors.index.json).
+    Torch-free: safe_open(framework="np") yields numpy views with fp16 and
+    (ml_dtypes) bf16 preserved. One tensor is materialized per lookup."""
+
+    def __init__(self, model_path: str):
+        index = os.path.join(model_path, "model.safetensors.index.json")
+        single = os.path.join(model_path, "model.safetensors")
+        self._key2file: Dict[str, str] = {}
+        self._handles: Dict[str, Any] = {}
+        if os.path.isfile(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            self._key2file = {
+                k: os.path.join(model_path, v) for k, v in weight_map.items()
+            }
+        elif os.path.isfile(single):
+            from safetensors import safe_open
+
+            with safe_open(single, framework="np") as sf:
+                self._key2file = {k: single for k in sf.keys()}
+        else:
+            raise FileNotFoundError(
+                f"no safetensors checkpoint under {model_path!r}"
+            )
+
+    def _handle(self, file: str):
+        if file not in self._handles:
+            from safetensors import safe_open
+
+            self._handles[file] = safe_open(file, framework="np")
+        return self._handles[file]
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return self._handle(self._key2file[key]).get_tensor(key)
+
+    def __contains__(self, key) -> bool:
+        return key in self._key2file
+
+    def __iter__(self):
+        return iter(self._key2file)
+
+    def keys(self):
+        return self._key2file.keys()
+
+
+def load_hf_trunk(model_path: str, cfg: LMConfig, put=None) -> Dict[str, Any]:
+    """Convert an HF checkpoint's transformer trunk to our Flax layout.
+
+    Streams per tensor from safetensors when present (`put` is applied to
+    each converted tensor immediately — dtype cast + sharded device
+    placement); falls back to a full torch load for legacy
+    pytorch_model.bin checkpoints."""
+    try:
+        sd: Any = LazySafetensors(model_path)
+    except (FileNotFoundError, NotADirectoryError):
+        import torch  # host-only legacy fallback
+
+        from transformers import AutoModelForCausalLM
+
+        hf_model = AutoModelForCausalLM.from_pretrained(model_path, torch_dtype=torch.float32)
+        sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+        del hf_model
     t = _detect_family(sd)
-    if t == "gpt2":
-        return convert_gpt2(sd, cfg)
-    if t == "gptj":
-        return convert_gptj(sd, cfg)
-    if t == "gpt_neo":
-        return convert_gpt_neo(sd, cfg)
-    if t == "gpt_neox":
-        return convert_neox(sd, cfg)
-    raise ValueError(f"cannot detect supported family from state dict ({list(sd)[:3]}...)")
+    if t == "unknown":
+        raise ValueError(
+            f"cannot detect supported family from state dict ({list(sd)[:3]}...)"
+        )
+    return materialize_spec(trunk_spec(t, cfg), sd, put=put)
 
 
 def _detect_family(sd) -> str:
@@ -164,140 +312,208 @@ def _detect_family(sd) -> str:
     return "unknown"
 
 
-def _ln(sd, prefix):
-    return {"scale": sd[f"{prefix}.weight"], "bias": sd[f"{prefix}.bias"]}
+# --------------------------------------------------------------------------
+# Conversion specs: trees of per-leaf thunks `fn(sd) -> np.ndarray`, so a
+# lazy state dict materializes ONE source tensor per target leaf. The eager
+# convert_* functions below are materializations of these specs.
 
 
-def convert_gpt2(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+def _id(key):
+    def f(sd):
+        return np.asarray(sd[key])
+
+    return f
+
+
+def _t(key):
+    def f(sd):
+        return np.asarray(sd[key]).T
+
+    return f
+
+
+def _ln_spec(prefix):
+    return {"scale": _id(f"{prefix}.weight"), "bias": _id(f"{prefix}.bias")}
+
+
+def materialize_spec(spec: Dict[str, Any], sd, put: Optional[Callable] = None) -> Dict[str, Any]:
+    """Evaluate a spec tree against a (possibly lazy) state dict, applying
+    `put(path, arr)` to each tensor as soon as it is converted."""
+
+    def mat(path, thunk):
+        arr = thunk(sd)
+        return put(_path_str(path), arr) if put is not None else arr
+
+    return jax.tree_util.tree_map_with_path(mat, spec)
+
+
+def trunk_spec(family: str, cfg: LMConfig) -> Dict[str, Any]:
+    if family == "gpt2":
+        return _spec_gpt2(cfg)
+    if family == "gptj":
+        return _spec_gptj(cfg)
+    if family == "gpt_neo":
+        return _spec_gpt_neo(cfg)
+    if family == "gpt_neox":
+        return _spec_neox(cfg)
+    raise ValueError(f"unsupported family: {family}")
+
+
+def _spec_gpt2(cfg: LMConfig) -> Dict[str, Any]:
     """GPT-2: HF Conv1D weights are already [in, out] — direct copy."""
     p: Dict[str, Any] = {
-        "wte": {"embedding": sd["transformer.wte.weight"]},
-        "wpe": {"embedding": sd["transformer.wpe.weight"]},
-        "ln_f": _ln(sd, "transformer.ln_f"),
+        "wte": {"embedding": _id("transformer.wte.weight")},
+        "wpe": {"embedding": _id("transformer.wpe.weight")},
+        "ln_f": _ln_spec("transformer.ln_f"),
     }
     if not cfg.tie_word_embeddings:
         # Canonical gpt2 ties; an untied checkpoint (e.g. our own export of
         # an untied from-scratch arch) carries a real head.
-        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+        p["lm_head"] = {"kernel": _t("lm_head.weight")}
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         p[f"h_{i}"] = {
-            "ln_1": _ln(sd, f"{h}.ln_1"),
-            "ln_2": _ln(sd, f"{h}.ln_2"),
+            "ln_1": _ln_spec(f"{h}.ln_1"),
+            "ln_2": _ln_spec(f"{h}.ln_2"),
             "attn": {
-                "c_qkv": {"kernel": sd[f"{h}.attn.c_attn.weight"], "bias": sd[f"{h}.attn.c_attn.bias"]},
-                "c_proj": {"kernel": sd[f"{h}.attn.c_proj.weight"], "bias": sd[f"{h}.attn.c_proj.bias"]},
+                "c_qkv": {"kernel": _id(f"{h}.attn.c_attn.weight"), "bias": _id(f"{h}.attn.c_attn.bias")},
+                "c_proj": {"kernel": _id(f"{h}.attn.c_proj.weight"), "bias": _id(f"{h}.attn.c_proj.bias")},
             },
             "mlp": {
-                "c_fc": {"kernel": sd[f"{h}.mlp.c_fc.weight"], "bias": sd[f"{h}.mlp.c_fc.bias"]},
-                "c_proj": {"kernel": sd[f"{h}.mlp.c_proj.weight"], "bias": sd[f"{h}.mlp.c_proj.bias"]},
+                "c_fc": {"kernel": _id(f"{h}.mlp.c_fc.weight"), "bias": _id(f"{h}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": _id(f"{h}.mlp.c_proj.weight"), "bias": _id(f"{h}.mlp.c_proj.bias")},
             },
         }
     return p
 
 
-def convert_gptj(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+def _spec_gptj(cfg: LMConfig) -> Dict[str, Any]:
     """GPT-J: nn.Linear weights are [out, in] — transpose to Flax [in, out]."""
     p: Dict[str, Any] = {
-        "wte": {"embedding": sd["transformer.wte.weight"]},
-        "ln_f": _ln(sd, "transformer.ln_f"),
+        "wte": {"embedding": _id("transformer.wte.weight")},
+        "ln_f": _ln_spec("transformer.ln_f"),
     }
     if not cfg.tie_word_embeddings:
-        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+        p["lm_head"] = {"kernel": _t("lm_head.weight")}
         if cfg.extra.get("lm_head_bias", False):
-            p["lm_head"]["bias"] = sd["lm_head.bias"]
+            p["lm_head"]["bias"] = _id("lm_head.bias")
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         p[f"h_{i}"] = {
-            "ln_1": _ln(sd, f"{h}.ln_1"),
+            "ln_1": _ln_spec(f"{h}.ln_1"),
             "attn": {
-                "q_proj": {"kernel": sd[f"{h}.attn.q_proj.weight"].T},
-                "k_proj": {"kernel": sd[f"{h}.attn.k_proj.weight"].T},
-                "v_proj": {"kernel": sd[f"{h}.attn.v_proj.weight"].T},
-                "c_proj": {"kernel": sd[f"{h}.attn.out_proj.weight"].T},
+                "q_proj": {"kernel": _t(f"{h}.attn.q_proj.weight")},
+                "k_proj": {"kernel": _t(f"{h}.attn.k_proj.weight")},
+                "v_proj": {"kernel": _t(f"{h}.attn.v_proj.weight")},
+                "c_proj": {"kernel": _t(f"{h}.attn.out_proj.weight")},
             },
             "mlp": {
-                "c_fc": {"kernel": sd[f"{h}.mlp.fc_in.weight"].T, "bias": sd[f"{h}.mlp.fc_in.bias"]},
-                "c_proj": {"kernel": sd[f"{h}.mlp.fc_out.weight"].T, "bias": sd[f"{h}.mlp.fc_out.bias"]},
+                "c_fc": {"kernel": _t(f"{h}.mlp.fc_in.weight"), "bias": _id(f"{h}.mlp.fc_in.bias")},
+                "c_proj": {"kernel": _t(f"{h}.mlp.fc_out.weight"), "bias": _id(f"{h}.mlp.fc_out.bias")},
             },
         }
     return p
 
 
-def convert_gpt_neo(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+def _spec_gpt_neo(cfg: LMConfig) -> Dict[str, Any]:
     """GPT-Neo: gpt2-style trunk but nn.Linear projections ([out, in] →
     transpose), biasless q/k/v, tied head."""
     p: Dict[str, Any] = {
-        "wte": {"embedding": sd["transformer.wte.weight"]},
-        "wpe": {"embedding": sd["transformer.wpe.weight"]},
-        "ln_f": _ln(sd, "transformer.ln_f"),
+        "wte": {"embedding": _id("transformer.wte.weight")},
+        "wpe": {"embedding": _id("transformer.wpe.weight")},
+        "ln_f": _ln_spec("transformer.ln_f"),
     }
     if not cfg.tie_word_embeddings:
-        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+        p["lm_head"] = {"kernel": _t("lm_head.weight")}
     for i in range(cfg.n_layer):
         h = f"transformer.h.{i}"
         a = f"{h}.attn.attention"
         p[f"h_{i}"] = {
-            "ln_1": _ln(sd, f"{h}.ln_1"),
-            "ln_2": _ln(sd, f"{h}.ln_2"),
+            "ln_1": _ln_spec(f"{h}.ln_1"),
+            "ln_2": _ln_spec(f"{h}.ln_2"),
             "attn": {
-                "q_proj": {"kernel": sd[f"{a}.q_proj.weight"].T},
-                "k_proj": {"kernel": sd[f"{a}.k_proj.weight"].T},
-                "v_proj": {"kernel": sd[f"{a}.v_proj.weight"].T},
-                "c_proj": {"kernel": sd[f"{a}.out_proj.weight"].T, "bias": sd[f"{a}.out_proj.bias"]},
+                "q_proj": {"kernel": _t(f"{a}.q_proj.weight")},
+                "k_proj": {"kernel": _t(f"{a}.k_proj.weight")},
+                "v_proj": {"kernel": _t(f"{a}.v_proj.weight")},
+                "c_proj": {"kernel": _t(f"{a}.out_proj.weight"), "bias": _id(f"{a}.out_proj.bias")},
             },
             "mlp": {
-                "c_fc": {"kernel": sd[f"{h}.mlp.c_fc.weight"].T, "bias": sd[f"{h}.mlp.c_fc.bias"]},
-                "c_proj": {"kernel": sd[f"{h}.mlp.c_proj.weight"].T, "bias": sd[f"{h}.mlp.c_proj.bias"]},
+                "c_fc": {"kernel": _t(f"{h}.mlp.c_fc.weight"), "bias": _id(f"{h}.mlp.c_fc.bias")},
+                "c_proj": {"kernel": _t(f"{h}.mlp.c_proj.weight"), "bias": _id(f"{h}.mlp.c_proj.bias")},
             },
         }
     return p
 
 
-def convert_neox(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+def _spec_neox(cfg: LMConfig) -> Dict[str, Any]:
     """GPT-NeoX: fused query_key_value is laid out [n_head, 3, head_dim] on
     the output dim — permute into our q|k|v block layout."""
     nh, hd, d = cfg.n_head, cfg.head_dim, cfg.d_model
 
-    def qkv_w(w):  # [3d, d] torch → [d, 3d] ours (q|k|v)
-        w = w.reshape(nh, 3, hd, d)  # heads-major interleave
-        w = np.concatenate([w[:, j] for j in range(3)], axis=0)  # [3*nh, hd, d]
-        return w.reshape(3 * d, d).T
+    def qkv_w(key):
+        def f(sd):  # [3d, d] torch → [d, 3d] ours (q|k|v)
+            w = np.asarray(sd[key]).reshape(nh, 3, hd, d)  # heads-major interleave
+            w = np.concatenate([w[:, j] for j in range(3)], axis=0)  # [3*nh, hd, d]
+            return w.reshape(3 * d, d).T
 
-    def qkv_b(b):
-        b = b.reshape(nh, 3, hd)
-        return np.concatenate([b[:, j] for j in range(3)], axis=0).reshape(3 * d)
+        return f
+
+    def qkv_b(key):
+        def f(sd):
+            b = np.asarray(sd[key]).reshape(nh, 3, hd)
+            return np.concatenate([b[:, j] for j in range(3)], axis=0).reshape(3 * d)
+
+        return f
 
     p: Dict[str, Any] = {
-        "wte": {"embedding": sd["gpt_neox.embed_in.weight"]},
-        "ln_f": _ln(sd, "gpt_neox.final_layer_norm"),
+        "wte": {"embedding": _id("gpt_neox.embed_in.weight")},
+        "ln_f": _ln_spec("gpt_neox.final_layer_norm"),
     }
     if not cfg.tie_word_embeddings:
-        p["lm_head"] = {"kernel": sd["embed_out.weight"].T}
+        p["lm_head"] = {"kernel": _t("embed_out.weight")}
     for i in range(cfg.n_layer):
         h = f"gpt_neox.layers.{i}"
         p[f"h_{i}"] = {
-            "ln_1": _ln(sd, f"{h}.input_layernorm"),
-            "ln_2": _ln(sd, f"{h}.post_attention_layernorm"),
+            "ln_1": _ln_spec(f"{h}.input_layernorm"),
+            "ln_2": _ln_spec(f"{h}.post_attention_layernorm"),
             "attn": {
                 "c_qkv": {
-                    "kernel": qkv_w(sd[f"{h}.attention.query_key_value.weight"]),
-                    "bias": qkv_b(sd[f"{h}.attention.query_key_value.bias"]),
+                    "kernel": qkv_w(f"{h}.attention.query_key_value.weight"),
+                    "bias": qkv_b(f"{h}.attention.query_key_value.bias"),
                 },
                 "c_proj": {
-                    "kernel": sd[f"{h}.attention.dense.weight"].T,
-                    "bias": sd[f"{h}.attention.dense.bias"],
+                    "kernel": _t(f"{h}.attention.dense.weight"),
+                    "bias": _id(f"{h}.attention.dense.bias"),
                 },
             },
             "mlp": {
                 "c_fc": {
-                    "kernel": sd[f"{h}.mlp.dense_h_to_4h.weight"].T,
-                    "bias": sd[f"{h}.mlp.dense_h_to_4h.bias"],
+                    "kernel": _t(f"{h}.mlp.dense_h_to_4h.weight"),
+                    "bias": _id(f"{h}.mlp.dense_h_to_4h.bias"),
                 },
                 "c_proj": {
-                    "kernel": sd[f"{h}.mlp.dense_4h_to_h.weight"].T,
-                    "bias": sd[f"{h}.mlp.dense_4h_to_h.bias"],
+                    "kernel": _t(f"{h}.mlp.dense_4h_to_h.weight"),
+                    "bias": _id(f"{h}.mlp.dense_4h_to_h.bias"),
                 },
             },
         }
     return p
+
+
+# Eager converters (tests and tooling): materializations of the specs above.
+
+
+def convert_gpt2(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    return materialize_spec(_spec_gpt2(cfg), sd)
+
+
+def convert_gptj(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    return materialize_spec(_spec_gptj(cfg), sd)
+
+
+def convert_gpt_neo(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    return materialize_spec(_spec_gpt_neo(cfg), sd)
+
+
+def convert_neox(sd: Dict[str, np.ndarray], cfg: LMConfig) -> Dict[str, Any]:
+    return materialize_spec(_spec_neox(cfg), sd)

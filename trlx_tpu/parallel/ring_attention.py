@@ -338,6 +338,13 @@ def ring_attention_sharded(q, k, v, kv_mask, *, scale: float, causal: bool = Tru
     half-chunks {r, 2n−1−r}) whenever causal and T divides 2·n_ring, else the
     contiguous layout; "zigzag"/"contiguous" force. The zig-zag permutation is
     applied and inverted HERE, so callers always see natural sequence order.
+
+    Cost note: the permutation round-trip is 5 cross-shard gathers of O(T·h·d)
+    per attention call. Attention compute is O(T²·h·d/n) per rank, so the
+    movement is a ~n/T fraction of the work — noise at the long sequences sp
+    targets (T ≥ 8k), but measurable at short T; pass layout="contiguous" to
+    opt out there (short sequences are also where the causal imbalance being
+    fixed costs the least).
     """
     from jax.sharding import PartitionSpec as P
 

@@ -542,12 +542,16 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # log_interval > 1 the device queue stays full
                         # between logs.
                         stats_host = {k: float(v) for k, v in stats.items()}
-                        if intervals["do_eval"]:
-                            stats_host.update(self.evaluate())
+                        # step_time BEFORE any evaluate(): the stats read just
+                        # above synced the step; folding eval seconds in would
+                        # make the logged throughput wrong by orders of
+                        # magnitude on eval steps.
                         stats_host["step_time"] = time.time() - forward_t0
                         stats_host["samples_per_sec"] = (
                             self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
                         )
+                        if intervals["do_eval"]:
+                            stats_host.update(self.evaluate())
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
 
