@@ -12,14 +12,19 @@ steps — and reports, alongside samples/s/chip:
   iteration, against the detected chip's peak bf16 FLOP/s,
 - the honest model identity (a GPT-J-family architecture auto-sized to the
   chip's HBM — "gptj-l28-d4096" IS 6B; smaller chips bench a smaller
-  truthfully-named proxy).
+  truthfully-named proxy),
+- the PIPELINED orchestrator path (PPOOrchestrator.make_experience, where
+  the next chunk's generation is dispatched before the current chunk's host
+  scoring) measured against the same phases run serialized, as
+  "overlap_gain_pct" — the design claim, measured rather than asserted,
+- an fp32-master measured point (the production master-weights dtype) on a
+  smaller HBM-fitting size, alongside the flagship bf16 throughput entry.
 
 The default preset is "auto": the largest HBM-fitting entry from SIZES at
 seq 1024 (768-token prefill + 256-token decode), which routes scoring and
 training attention through the pallas flash kernel. The reference publishes
-no numbers (BASELINE.md); the recorded Accelerate-GPU comparison baseline is
-1.0 samples/sec/chip until a measured reference lands, so vs_baseline ==
-value.
+no numbers and no measured Accelerate-GPU baseline exists in this
+environment (BASELINE.md), so vs_baseline is null — not a placeholder ratio.
 """
 
 import gc
@@ -45,6 +50,15 @@ SIZES = [
     ("gptj-l4-d4096-1.2B-bf16", 4, 4096, 16, 50400, 768, 256, 8, 2, 32),
     ("gptj-l4-d2048-0.4B-bf16", 4, 2048, 16, 50400, 768, 256, 8, 2, 32),
     ("gptj-l2-d512-tiny", 2, 512, 8, 1024, 256, 128, 4, 1, 8),
+]
+# fp32-master measured points (production master-weights dtype; the big
+# recipes shard fp32 masters over fsdp on a pod — single-chip benches the
+# largest fp32 size that fits). Largest-fitting entry runs as a SECONDARY
+# measurement alongside the flagship bf16 number.
+FP32_SIZES = [
+    ("gptj-l6-d2048-0.5B-fp32", 6, 2048, 16, 50400, 768, 256, 8, 2, 32),
+    ("gptj-l4-d2048-0.4B-fp32", 4, 2048, 16, 50400, 768, 256, 8, 2, 32),
+    ("gptj-l2-d1024-0.1B-fp32", 2, 1024, 16, 50400, 768, 256, 8, 1, 16),
 ]
 # Legacy fixed presets (BENCH_PRESET env) — the r1 shapes, kept comparable.
 PRESETS = {
@@ -106,6 +120,25 @@ def hbm_bytes():
     return None
 
 
+def is_oom(e: Exception) -> bool:
+    """Robust allocator-failure detection for the auto-size fallback: match
+    the jaxlib error type when available, else a broad substring net —
+    differently-worded allocator errors must try the next size, not abort."""
+    try:
+        from jax.errors import JaxRuntimeError
+
+        if isinstance(e, JaxRuntimeError) and any(
+            s in str(e).lower() for s in ("alloc", "exhausted", "memory", "oom", "hbm")
+        ):
+            return True
+    except ImportError:
+        pass
+    msg = str(e).lower()
+    return any(
+        s in msg for s in ("resource_exhausted", "out of memory", "exhausted", "alloc", "oom", "hbm")
+    )
+
+
 def fits_hbm(L, d, vocab, unfrozen, hbm, param_bytes=2):
     """Rough static-memory model: master params + Adam moments on trainable
     params (top `unfrozen` blocks + embeddings + heads) + frozen ref branch
@@ -143,38 +176,78 @@ def main():
             pass
 
     preset = os.environ.get("BENCH_PRESET", "auto")
+    fp32_point = os.environ.get("BENCH_FP32_POINT", "1") == "1"
     if preset != "auto":
         candidates = [PRESETS[preset]]
+        fp32_candidates = []
     else:
         hbm = hbm_bytes()
         candidates = [
             s for s in SIZES if hbm is None or fits_hbm(s[1], s[2], s[4], s[8], hbm)
         ] or [SIZES[-1]]
-        if jax.default_backend() != "tpu":  # CPU dev runs: smallest only
+        fp32_candidates = [
+            s
+            for s in FP32_SIZES
+            if hbm is None or fits_hbm(s[1], s[2], s[4], s[8], hbm, param_bytes=4)
+        ] or [FP32_SIZES[-1]]
+        if jax.default_backend() != "tpu":  # CPU dev runs: smallest only —
+            # and no default fp32 point (seq-1024 fp32 on CPU takes hours);
+            # set BENCH_FP32_POINT=1 explicitly to force it.
             candidates = [SIZES[-1]]
+            fp32_candidates = [FP32_SIZES[-1]]
+            fp32_point = os.environ.get("BENCH_FP32_POINT") == "1"
 
-    result = None
-    for cand in candidates:
-        try:
-            result = run_one(cand)
-            break
-        except Exception as e:  # OOM on an optimistic size → next smaller
-            msg = str(e)
-            if "RESOURCE_EXHAUSTED" not in msg and "out of memory" not in msg.lower():
-                raise
-            # Drop the traceback BEFORE collecting: its frames pin the failed
-            # trainer's device arrays, and a leaked attempt OOMs every
-            # subsequent (even tiny) size.
-            e.__traceback__ = None
-            del e
-            print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
-        gc.collect()
+    def first_fitting(cands, **kwargs):
+        for cand in cands:
+            try:
+                return run_one(cand, **kwargs)
+            except Exception as e:  # OOM on an optimistic size → next smaller
+                if not is_oom(e):
+                    raise
+                # Drop the traceback BEFORE collecting: its frames pin the
+                # failed trainer's device arrays, and a leaked attempt OOMs
+                # every subsequent (even tiny) size.
+                e.__traceback__ = None
+                del e
+                print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
+            gc.collect()
+        return None
+
+    result = first_fitting(candidates)
     if result is None:
         raise RuntimeError("no bench size fit the device")
+    if fp32_candidates and fp32_point:
+        gc.collect()
+        fp32 = first_fitting(fp32_candidates, iters=2, orchestrator=False)
+        if fp32 is not None:
+            result["fp32_master_point"] = {
+                k: fp32[k]
+                for k in (
+                    "metric",
+                    "value",
+                    "unit",
+                    "phase_seconds_per_iter",
+                    "train_mfu_pct",
+                    "iter_mfu_pct",
+                )
+                if k in fp32
+            }
     print(json.dumps(result))
 
 
-def run_one(cand):
+def device_sync(tree):
+    """True device sync: host-read one scalar of the result. On the tunneled
+    axon backend block_until_ready does NOT actually block, so a tiny
+    transfer is the only reliable phase barrier (and the real PPO cadence
+    has exactly these host reads anyway). Do NOT 'simplify' to
+    block_until_ready — it would silently skew every phase timing on axon."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+
+def run_one(cand, iters=None, orchestrator=True):
     import jax
 
     name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen, C = cand
@@ -245,13 +318,7 @@ def run_one(cand):
     prompt_ids = rng.integers(2, vocab, size=(C, P)).astype(np.int32)
     prompt_mask = np.ones((C, P), dtype=np.int32)
 
-    def sync(tree):
-        """True device sync: host-read one scalar of the result. On the
-        tunneled axon backend block_until_ready does NOT actually block, so
-        a tiny transfer is the only reliable phase barrier (and the real PPO
-        cadence has exactly these host reads anyway)."""
-        leaf = jax.tree_util.tree_leaves(tree)[0]
-        np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+    sync = device_sync
 
     def phase_generate():
         tokens, mask = trainer.rollout_generate(prompt_ids, prompt_mask)
@@ -291,7 +358,7 @@ def run_one(cand):
     logprobs, values, rewards, _ = phase_score(tokens, mask)
     phase_train(tokens, mask, logprobs, values, rewards, warmup=True)
 
-    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    iters = iters if iters is not None else int(os.environ.get("BENCH_ITERS", "3"))
     t_gen = t_score = t_train = 0.0
     t0 = time.time()
     for _ in range(iters):
@@ -337,8 +404,10 @@ def run_one(cand):
     out = {
         "metric": f"ppo_samples_per_sec_per_chip[{name},seq{T},prefill{P}+decode{R},chunk{C},b{B}]",
         "value": round(sps_per_chip, 3),
+        # No measured Accelerate-GPU reference exists in this environment
+        # (BASELINE.md) — null, not a fabricated ratio.
+        "vs_baseline": None,
         "unit": "samples/s/chip",
-        "vs_baseline": round(sps_per_chip, 3),
         "device_kind": kind,
         "n_chips": n_chips,
         "phase_seconds_per_iter": {
@@ -353,7 +422,85 @@ def run_one(cand):
         out["peak_bf16_tflops"] = peak
         out["train_mfu_pct"] = round(100 * train_tflops / peak, 2)
         out["iter_mfu_pct"] = round(100 * iter_tflops / peak, 2)
+    if orchestrator:
+        out["orchestrator"] = bench_orchestrator(trainer, C, P, vocab)
     return out
+
+
+def bench_orchestrator(trainer, C, P, vocab):
+    """Measure the PIPELINED rollout path (PPOOrchestrator.make_experience:
+    the next chunk's generation is dispatched before the current chunk's
+    decode + host reward_fn + scoring) against the SAME work run serialized
+    (full device sync between every phase). The delta is the overlap the
+    orchestrator design buys; reported as overlap_gain_pct.
+
+    The host reward here is a real (cheap) numpy pass over the decoded token
+    rows; BENCH_HOST_MS adds emulated heavier host scoring (e.g. a sentiment
+    model) per chunk to probe how the gain scales with host cost."""
+    import jax
+
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    host_ms = float(os.environ.get("BENCH_HOST_MS", "0"))
+    rng = np.random.default_rng(7)
+
+    def reward_fn(rows):
+        if host_ms:
+            time.sleep(host_ms / 1e3)
+        return [float(np.mean(np.asarray(r, np.float32)) / vocab) for r in rows]
+
+    prompts = [list(map(int, rng.integers(2, vocab, size=P))) for _ in range(C)]
+    pipeline = PromptPipeline(prompts, None, max_prompt_length=P)
+    orch = PPOOrchestrator(trainer, pipeline, reward_fn, chunk_size=C)
+    n_chunks = int(os.environ.get("BENCH_ORCH_CHUNKS", "3"))
+    rows_per_chunk = C // jax.process_count()
+    sync = device_sync
+
+    # Warmup: one pipelined pass compiles generate+score for this shape.
+    trainer.store.clear_history()
+    orch.make_experience(rows_per_chunk)
+
+    trainer.store.clear_history()
+    t0 = time.time()
+    orch.make_experience(n_chunks * rows_per_chunk)
+    t_pipelined = time.time() - t0
+
+    # Serialized twin: identical phases, hard sync between each (the
+    # reference's phase structure, reference:
+    # trlx/orchestrator/ppo_orchestrator.py:58-110).
+    trainer.store.clear_history()
+    t0 = time.time()
+    for _ in range(n_chunks):
+        tokens, mask, p_len = orch._generate_next_chunk()
+        sync(tokens)
+        tokens_h, mask_h = trainer.to_local_host((tokens, mask))
+        scores = np.asarray(reward_fn(trainer.decode(tokens_h, mask_h)), np.float32)
+        outs = trainer.rollout_score(tokens, mask, scores)
+        sync(outs[0])
+        logprobs, values, rewards, _ = trainer.to_local_host(outs)
+        trainer.store.push_batch(
+            {
+                "query_tensors": tokens_h[:, :p_len],
+                "query_mask": mask_h[:, :p_len],
+                "response_tensors": tokens_h[:, p_len:],
+                "response_mask": mask_h[:, p_len:],
+                "logprobs": logprobs,
+                "values": values,
+                "rewards": rewards,
+            }
+        )
+    t_serial = time.time() - t0
+    trainer.store.clear_history()
+
+    samples = n_chunks * C
+    return {
+        "samples_per_sec_per_chip": round(samples / t_pipelined / jax.device_count(), 3),
+        "serialized_samples_per_sec_per_chip": round(samples / t_serial / jax.device_count(), 3),
+        "overlap_gain_pct": round(100.0 * (t_serial - t_pipelined) / max(t_serial, 1e-9), 2),
+        "host_ms_emulated_per_chunk": host_ms,
+        "n_chunks": n_chunks,
+    }
 
 
 if __name__ == "__main__":

@@ -139,15 +139,16 @@ def test_stream_put_shards_on_mesh(tmp_path):
     the live mesh as it is converted — the tensors arrive sharded, never
     resident as a full host tree."""
     import jax
-    from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP, make_mesh, set_mesh
+    from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP, make_mesh, peek_mesh, set_mesh
 
     config = transformers.GPT2Config(n_layer=2, n_head=4, n_embd=64, vocab_size=128, n_positions=64)
     hf_model = transformers.GPT2LMHeadModel(config)
     ckpt = str(tmp_path / "mesh_ckpt")
     hf_model.save_pretrained(ckpt, safe_serialization=True)
 
-    mesh = make_mesh((2, 2, 2, 1))  # dp=2 fsdp=2 tp=2
-    set_mesh(mesh)
+    prior = peek_mesh()  # restore EXACT prior state: load_or_init_params
+    mesh = make_mesh((2, 2, 2, 1))  # branches on peek_mesh(), so a leaked
+    set_mesh(mesh)  # mesh would change later tests' init path
     try:
         cfg = lm_config_from_hf(hf_model.config, dtype="float32", param_dtype="float32")
         model = TransformerLM(cfg)
@@ -161,7 +162,7 @@ def test_stream_put_shards_on_mesh(tmp_path):
         ln = trunk["h_0"]["ln_1"]["scale"]
         assert tuple(ln.sharding.spec) in ((), (None,))  # replicated
     finally:
-        set_mesh(make_mesh((-1, 1, 1, 1)))
+        set_mesh(prior)
 
 
 MEMORY_PROBE = r"""

@@ -144,7 +144,7 @@ def _no_shuffle_init(self, n, batch_size, collate, shuffle=False, drop_last=True
     _orig_init(self, n, batch_size, collate, shuffle=False, drop_last=drop_last, seed=seed)
 BatchLoader.__init__ = _no_shuffle_init
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.environ["TRLX_REPO"]), os.path.basename(os.environ["TRLX_REPO"]), "examples"))
+sys.path.insert(0, os.path.join(os.environ["TRLX_REPO"], "examples"))
 import trlx_tpu
 from randomwalks import base_config, generate_random_walks
 
