@@ -1,0 +1,146 @@
+"""Fused in-decode rollout statistics: the decode loop emits the policy
+logprobs/values/branch-hiddens the scorer needs, so rollout scoring becomes a
+ref-branch replay only. These tests pin the fused path to the unfused full
+re-forward numerically, and run it end to end."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def _hydra_config(tmp_path, total_steps=4):
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = total_steps
+    config.train.epochs = 2
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.model.num_layers_unfrozen = 1  # branch_layer = n_layer - 1 >= 0
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    return config
+
+
+def test_fused_matches_unfused_scoring(task, tmp_path):
+    """Same tokens, same scores: the fused scorer (decode-collected stats +
+    ref-branch replay) must reproduce the unfused full-re-forward scorer's
+    logprobs, values, rewards, and KL on valid response positions — and
+    record EXACT ZEROS after a row finishes. No logit_mask here, so the tiny
+    random policy samples eos (token 0) early in some rows, making the
+    post-finish assertions non-vacuous (asserted below)."""
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = _hydra_config(tmp_path)
+    trainer = PPOTrainer(config)
+    assert trainer.fused_rollout
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 15, size=(16, 1)).astype(np.int32)
+    pmask = np.ones_like(prompts)
+
+    tokens, mask, stats, prefill = trainer.rollout_generate_fused(prompts, pmask)
+    scores = rng.normal(size=(16,)).astype(np.float32)
+
+    f_lp, f_v, f_rw, f_kl = (
+        np.asarray(x) for x in trainer.rollout_score_fused(tokens, mask, scores, (stats, prefill))
+    )
+    u_lp, u_v, u_rw, u_kl = (
+        np.asarray(x) for x in trainer.rollout_score(tokens, mask, scores)
+    )
+
+    P = trainer.prompt_length
+    rmask = np.asarray(mask)[:, P:].astype(bool)
+    assert rmask.any()
+    assert (~rmask).any(), "no row finished early — the zero-pad assertions would be vacuous"
+    np.testing.assert_allclose(f_lp[rmask], u_lp[rmask], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f_v[rmask], u_v[rmask], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f_rw[rmask], u_rw[rmask], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f_kl[rmask], u_kl[rmask], rtol=1e-4, atol=1e-4)
+    # Post-finish entries are exact zeros in the fused stats (generate()
+    # masks step stats by liveness) — the pad_sequence convention.
+    assert np.all(f_lp[~rmask] == 0)
+    assert np.all(f_v[~rmask] == 0)
+
+
+def test_fused_rollout_e2e_learns(task, tmp_path):
+    """Full train() through the fused rollout path (hydra model): the run
+    completes and the fused flag actually engaged."""
+    from trlx_tpu.trainer.ppo import PPOTrainer  # noqa: F401
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = _hydra_config(tmp_path)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.fused_rollout
+    assert model.iter_count >= 4
+    assert len(model.store) > 0
+
+
+def test_fused_disengages_without_branch(task, tmp_path):
+    """Fully-unfrozen models (no hydra branch) must fall back to the unfused
+    scorer — the frozen ref there is a full separate forward."""
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = _hydra_config(tmp_path)
+    config.model.num_layers_unfrozen = -1
+    trainer = PPOTrainer(config, logit_mask=logit_mask)
+    assert not trainer.fused_rollout
+
+
+def test_fused_rollout_learning_gate(tmp_path):
+    """Learning-QUALITY gate for the fused path (the default for hydra
+    models): the n=21 randomwalks config must reach ≥0.8 eval optimality in
+    48 steps with a frozen bottom layer — a fused-stats numerics regression
+    (stale logprobs, wrong value alignment) fails this even if the smokes
+    pass. Measured headroom: ~0.95 by step 48."""
+    n_nodes, max_length = 21, 10
+    walks, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=n_nodes, max_length=max_length
+    )
+    config = base_config("ppo", n_nodes, max_length)
+    config.train.total_steps = 48
+    config.train.eval_interval = 16
+    config.train.checkpoint_interval = 10**6
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 48
+    config.model.num_layers_unfrozen = 1
+    config.method.num_rollouts = 96
+    config.method.chunk_size = 48
+
+    history = []
+
+    def gated_metric(samples):
+        m = metric_fn(samples)
+        history.append(float(np.mean(m["optimality"])))
+        return m
+
+    prompts = [[int(np.random.default_rng(i).integers(1, n_nodes))] for i in range(96)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts,
+        eval_prompts=[[i] for i in range(1, n_nodes)], metric_fn=gated_metric,
+        config=config, logit_mask=logit_mask,
+    )
+    assert model.fused_rollout
+    assert history, "no eval ever ran"
+    assert max(history) >= 0.8, f"fused-path optimality history: {history}"

@@ -74,6 +74,12 @@ class PPOConfig(MethodConfig):
     cliprange_value: float = 0.2
     vf_coef: float = 1.0
     gen_kwargs: dict = field(default_factory=dict)
+    # TPU addition: collect rollout statistics (sampled-token logprobs,
+    # values, branch-point hiddens) INSIDE the decode loop, so rollout
+    # scoring skips the full policy re-forward and only replays the frozen
+    # ref branch. Engages when the hydra branch exists (num_layers_unfrozen
+    # in (0, n_layer)) and no on-device RM is configured.
+    fused_rollout_stats: bool = True
 
 
 @dataclass

@@ -37,6 +37,8 @@ def generate(
     processor: Optional[Callable] = None,
     carry_keys: Tuple[str, ...] = (),
     step_stats_fn: Optional[Callable] = None,
+    apply_kwargs: Optional[dict] = None,
+    prefill_collect: Tuple[str, ...] = (),
 ) -> Tuple[jnp.ndarray, ...]:
     """Decode `gcfg.max_new_tokens` tokens after left-padded prompts.
 
@@ -49,12 +51,27 @@ def generate(
     processor under state["carry"] — this is how advantage-steered decoding
     reads the Q/V heads each step.
 
-    `step_stats_fn(tok, state) -> {name: [b] float}` (optional) reduces the
-    in-loop state to per-step scalars — e.g. Q(s, tok) and V(s) from the
-    carry — which are collected into [b, max_new_tokens] float32 buffers and
-    returned as a third output. This makes decode diagnostics free: no extra
+    `step_stats_fn(tok, state) -> {name: [b, ...] float}` (optional) reduces
+    the in-loop state to per-step values — scalars (e.g. Q(s, tok), V(s), the
+    sampled token's raw logprob) or vectors (e.g. the branch-point hidden
+    state) — collected into [b, max_new_tokens, ...] buffers and returned as
+    a third output. This makes decode-side rollout statistics FREE: no extra
     forward pass after generation (validity = the returned mask's response
-    region). When set, the return is (tokens, mask, stats)."""
+    region). Scalar stats are stored fp32; vector stats keep their dtype.
+    When set, the return is (tokens, mask, stats).
+
+    `apply_kwargs` merges extra kwargs into every model.apply (prefill and
+    steps) — e.g. collect_branch_hidden=True. `prefill_collect` names prefill
+    output entries returned verbatim as a final `prefill_extras` dict (e.g.
+    the prompt region's branch-point hiddens for the fused PPO rollout
+    scorer); when non-empty the return is (tokens, mask, stats,
+    prefill_extras)."""
+    if prefill_collect and step_stats_fn is None:
+        raise ValueError(
+            "prefill_collect requires step_stats_fn — the 4-tuple "
+            "(tokens, mask, stats, prefill_extras) return is the only "
+            "supported shape for prefill collection"
+        )
     cfg = model.cfg
     B, P = prompt_ids.shape
     N = gcfg.max_new_tokens
@@ -109,6 +126,7 @@ def generate(
                 f"(data={data}, tp={tp}) — at large scale this can "
                 "replicate the cache per device"
             )
+    extra = apply_kwargs or {}
     out = model.apply(
         variables,
         input_ids=prompt_ids,
@@ -116,7 +134,9 @@ def generate(
         cache=cache,
         cache_index=0,
         cache_mask=with_soft(mask),
+        **extra,
     )
+    prefill_extras = {k: out[k] for k in prefill_collect}
 
     def last_pos(tree):
         return jax.tree_util.tree_map(lambda x: x[:, -1], tree)
@@ -133,11 +153,17 @@ def generate(
         "carry": {k: last_pos(out[k]) for k in carry_keys},
     }
     if step_stats_fn is not None:
-        # eval_shape: discover the stat names without executing the fn.
+        # eval_shape: discover the stat names/shapes without executing the fn.
         probe = jax.eval_shape(
             step_stats_fn, jax.ShapeDtypeStruct((B,), tokens.dtype), state
         )
-        state["stats"] = {k: jnp.zeros((B, N), dtype=jnp.float32) for k in probe}
+        state["stats"] = {
+            k: jnp.zeros(
+                (B, N) + tuple(v.shape[1:]),
+                dtype=jnp.float32 if v.ndim == 1 else v.dtype,
+            )
+            for k, v in probe.items()
+        }
 
     def cond(s):
         return (s["step"] < N) & ~jnp.all(s["finished"])
@@ -177,6 +203,7 @@ def generate(
             cache_index=write_pos + n_soft,
             cache_mask=with_soft(mask),
             prepend_soft=False,
+            **extra,
         )
         new_s = {
             "tokens": tokens,
@@ -192,22 +219,35 @@ def generate(
         if step_stats_fn is not None:
             # Stats read the PRE-step state: Q/V at the position that
             # produced `tok` (state-before-token, matching rollout scoring).
+            # Rows already finished record EXACT ZEROS — the pad_sequence
+            # convention the RL losses assume for post-EOS positions (and
+            # zeroed branch-hiddens are safe: post-finish positions are
+            # mask-0, so they are never attention keys).
             sv = step_stats_fn(tok, s)
+            live = ~was_finished
+
+            def _masked(v, dt):
+                return (v * live.reshape((-1,) + (1,) * (v.ndim - 1)).astype(v.dtype)).astype(dt)
+
             new_s["stats"] = {
                 k: jax.lax.dynamic_update_slice(
-                    s["stats"][k], sv[k].astype(jnp.float32)[:, None], (0, step)
+                    s["stats"][k],
+                    _masked(sv[k], s["stats"][k].dtype)[:, None],
+                    (0, step) + (0,) * (s["stats"][k].ndim - 2),
                 )
                 for k in s["stats"]
             }
         return new_s
 
     final = jax.lax.while_loop(cond, body, state)
+    if step_stats_fn is not None and prefill_collect:
+        return final["tokens"], final["mask"], final["stats"], prefill_extras
     if step_stats_fn is not None:
         return final["tokens"], final["mask"], final["stats"]
     return final["tokens"], final["mask"]
 
 
-def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = (), step_stats_fn: Optional[Callable] = None):
+def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = (), step_stats_fn: Optional[Callable] = None, apply_kwargs: Optional[dict] = None, prefill_collect: Tuple[str, ...] = ()):
     """Build a jitted generate fn of (variables, prompt_ids, prompt_mask, rng).
 
     Call once per (model, gcfg, processor) and reuse — each distinct
@@ -227,6 +267,8 @@ def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] 
         processor=processor,
         carry_keys=carry_keys,
         step_stats_fn=step_stats_fn,
+        apply_kwargs=apply_kwargs,
+        prefill_collect=prefill_collect,
     )
     jitted = jax.jit(fn)
 

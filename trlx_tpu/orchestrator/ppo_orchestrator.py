@@ -53,9 +53,16 @@ class PPOOrchestrator(Orchestrator):
             batch = next(self.pipeline_iterator)
         P = batch["input_ids"].shape[1]
         # Dispatched, not awaited: jax queues the compiled prefill+decode
-        # program and returns immediately.
+        # program and returns immediately. With fused rollout stats the same
+        # program also emits the policy logprobs/values/branch-hiddens the
+        # scorer needs (aux), so scoring is a ref-branch replay only.
+        if getattr(self.rl_model, "fused_rollout", False):
+            tokens, mask, stats, prefill = self.rl_model.rollout_generate_fused(
+                batch["input_ids"], batch["attention_mask"]
+            )
+            return tokens, mask, P, (stats, prefill)
         tokens, mask = self.rl_model.rollout_generate(batch["input_ids"], batch["attention_mask"])
-        return tokens, mask, P
+        return tokens, mask, P, None
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Fill the trainer's rollout store with `num_rollouts` rollout rows
@@ -72,7 +79,7 @@ class PPOOrchestrator(Orchestrator):
         clock = Clock()
         pending = self._generate_next_chunk()
         while True:
-            tokens, mask, P = pending
+            tokens, mask, P, gen_aux = pending
             # Rows THIS process will store (num_rollouts is per-process, the
             # reference's per-rank semantics). Static shape — no device sync.
             n_proc = jax.process_count()
@@ -111,8 +118,15 @@ class PPOOrchestrator(Orchestrator):
                 texts_or_tokens = self.rl_model.decode(tokens_h, mask_h)
                 scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
 
-                # Device: score rollouts (logprobs/values/ref-KL rewards fused).
-                logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
+                # Device: score rollouts. Fused: ref-branch replay only, the
+                # policy stats rode along with generation. Unfused: full
+                # policy forward + ref logits + KL rewards in one program.
+                if gen_aux is not None:
+                    logprobs, values, rewards, kl = self.rl_model.rollout_score_fused(
+                        tokens, mask, scores, gen_aux
+                    )
+                else:
+                    logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
 
             # Store holds process-local rows; put_batch re-shards them on the
             # way back to the device at train time.

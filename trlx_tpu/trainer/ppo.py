@@ -107,6 +107,44 @@ class PPOTrainer(JaxBaseTrainer):
         self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
         self._score_fn = jax.jit(partial(self._rollout_score_impl, prompt_length=self.prompt_length))
 
+        # Fused rollout statistics: the decode loop ALREADY computes every
+        # policy quantity rollout scoring needs — raw logits of each sampled
+        # token, the value head, and (hydra models) the branch-point hidden
+        # states. Collecting them in-loop makes the post-generation scoring
+        # pass a ref-branch replay ONLY: the full policy re-forward (most of
+        # the score phase's FLOPs) disappears. Engaged when a hydra branch
+        # exists and rollouts are scored by a host reward_fn (the on-device
+        # RM path keeps the fully-fused RM program instead).
+        self.fused_rollout = bool(
+            getattr(m, "fused_rollout_stats", True)
+            and self.model.branch_layer >= 0
+            and not config.model.has_reward_model
+        )
+        if self.fused_rollout:
+
+            def rollout_stats_fn(tok, s):
+                lp = jax.nn.log_softmax(s["last_logits"], axis=-1)  # fp32 raw
+                return {
+                    "logprob": jnp.take_along_axis(
+                        lp, tok[:, None].astype(jnp.int32), axis=-1
+                    )[:, 0],
+                    "value": s["carry"]["values"],
+                    "branch_hidden": s["carry"]["branch_hidden"],
+                }
+
+            self._generate_fused_fn = make_generate_fn(
+                self.model,
+                self.gen_cfg,
+                processor,
+                carry_keys=("values", "branch_hidden"),
+                step_stats_fn=rollout_stats_fn,
+                apply_kwargs={"collect_branch_hidden": True},
+                prefill_collect=("branch_hidden",),
+            )
+            self._score_fused_fn = jax.jit(
+                partial(self._rollout_score_fused_impl, prompt_length=self.prompt_length)
+            )
+
         # On-device learned reward model: a second LM + scalar head, sharded
         # with the SAME partition rules as the policy and scored inside the
         # fused rollout program — the pod-scale path a host reward_fn cannot
@@ -229,6 +267,55 @@ class PPOTrainer(JaxBaseTrainer):
     def rollout_generate(self, input_ids, attention_mask):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
         return self._generate_fn({"params": self.state.params}, batch["i"], batch["m"], self.next_rng())
+
+    def rollout_generate_fused(self, input_ids, attention_mask):
+        """Generation that also emits the rollout statistics (sampled-token
+        logprobs, values, branch hiddens) collected inside the decode loop.
+        Returns (tokens, mask, stats, prefill_extras) — feed the last two to
+        rollout_score_fused."""
+        batch = self.put_batch({"i": input_ids, "m": attention_mask})
+        return self._generate_fused_fn(
+            {"params": self.state.params}, batch["i"], batch["m"], self.next_rng()
+        )
+
+    def _rollout_score_fused_impl(self, extras, tokens, mask, scores, kl_coef, logprob, value, bh_steps, bh_prefill, *, prompt_length: int):
+        """Scoring with decode-collected stats: ONLY the frozen ref branch
+        replays (for KL); the policy's logprobs/values come from the decode
+        loop that produced the tokens (identical parameters, so they ARE the
+        behavior policy's quantities — same justification as the unfused
+        re-forward, minus its recompute).
+
+        The branch-hidden sequence is assembled as [prefill positions 0..P)
+        ; per-step entries 1.. (positions P..T-1) ; one zero pad at T-1] —
+        position T-1 is never read (it is no query's key under causality
+        once the last logits row is dropped), the pad only keeps the ring/
+        flash sequence shapes identical to the unfused path."""
+        P = prompt_length
+        bh = jnp.concatenate(
+            [bh_prefill, bh_steps[:, 1:], jnp.zeros_like(bh_steps[:, :1])], axis=1
+        )  # [b, T, d]
+        ref_logits = self.model.apply(
+            {"params": extras}, bh, mask, method="forward_branch", logits_start=P - 1
+        ).astype(jnp.float32)
+        rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
+        rmask = mask[:, P:]
+        rewards, kl = kl_penalty_rewards(logprob, rlp, rmask, scores, kl_coef)
+        return logprob, value, rewards, kl
+
+    def rollout_score_fused(self, tokens, mask, scores, gen_aux):
+        stats, prefill_extras = gen_aux
+        scores = self.put_batch(np.asarray(scores, dtype=np.float32))
+        return self._score_fused_fn(
+            self.state.extras,
+            tokens,
+            mask,
+            scores,
+            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+            stats["logprob"],
+            stats["value"],
+            stats["branch_hidden"],
+            prefill_extras["branch_hidden"],
+        )
 
     def _rollout_score_impl(self, params, extras, tokens, mask, scores, kl_coef, *, prompt_length: int):
         P = prompt_length
