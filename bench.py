@@ -163,7 +163,7 @@ def lm_flops(L, d, vocab, n_tokens, kv_avg, logits_tokens, value_head=False):
     return 2.0 * (n_tokens * per_tok + logits_tokens * d * vocab)
 
 
-def main():
+def _setup_compile_cache():
     import jax
 
     cache_dir = os.environ.get("BENCH_COMPILE_CACHE", os.path.expanduser("~/.cache/trlx_tpu/xla"))
@@ -174,6 +174,15 @@ def main():
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         except Exception:
             pass
+
+
+OOM_EXIT_CODE = 77
+
+
+def main():
+    import jax
+
+    _setup_compile_cache()
 
     preset = os.environ.get("BENCH_PRESET", "auto")
     fp32_point = os.environ.get("BENCH_FP32_POINT", "1") == "1"
@@ -197,20 +206,63 @@ def main():
             fp32_candidates = [FP32_SIZES[-1]]
             fp32_point = os.environ.get("BENCH_FP32_POINT") == "1"
 
-    def first_fitting(cands, **kwargs):
-        for cand in cands:
+    # On the real TPU each size candidate runs in a SUBPROCESS: an OOM'd
+    # attempt's device memory is only reliably reclaimed when its process
+    # dies (measured on the tunneled axon backend: after one in-process OOM
+    # even the tiny size fails), so in-process fallback would poison every
+    # subsequent size. CPU dev runs stay in-process (no such leak; subprocess
+    # jax re-init would dominate).
+    nonlocal_use_subproc = [
+        jax.default_backend() == "tpu" and os.environ.get("BENCH_SUBPROC", "1") == "1"
+    ]
+
+    def try_one(cand, **kwargs):
+        if not nonlocal_use_subproc[0]:
             try:
                 return run_one(cand, **kwargs)
-            except Exception as e:  # OOM on an optimistic size → next smaller
+            except Exception as e:
                 if not is_oom(e):
                     raise
                 # Drop the traceback BEFORE collecting: its frames pin the
-                # failed trainer's device arrays, and a leaked attempt OOMs
-                # every subsequent (even tiny) size.
+                # failed trainer's device arrays.
                 e.__traceback__ = None
                 del e
-                print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
-            gc.collect()
+                gc.collect()
+                return None
+        import subprocess
+
+        payload = json.dumps({"cand": cand, "kwargs": kwargs})
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", payload],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode == OOM_EXIT_CODE:
+            return None
+        if proc.returncode != 0:
+            # Standard TPU VMs hold libtpu exclusively per process: the
+            # parent's backend probe already claimed the device, so children
+            # can't. Fall back to in-process attempts there (the axon
+            # tunneled backend, where subprocess isolation is REQUIRED for
+            # OOM recovery, has no such exclusivity).
+            if "already in use" in proc.stderr or "libtpu" in proc.stderr.lower():
+                nonlocal_use_subproc[0] = False
+                print(
+                    "bench: TPU is process-exclusive here — falling back to "
+                    "in-process size attempts",
+                    file=sys.stderr,
+                )
+                return try_one(cand, **kwargs)
+            sys.stderr.write(proc.stderr[-4000:])
+            raise RuntimeError(f"bench subprocess failed for {cand[0]} (rc={proc.returncode})")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    def first_fitting(cands, **kwargs):
+        for cand in cands:
+            result = try_one(cand, **kwargs)
+            if result is not None:
+                return result
+            print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
         return None
 
     result = first_fitting(candidates)
@@ -287,11 +339,14 @@ def run_one(cand, iters=None, orchestrator=True):
         "extra": {"lm_head_bias": True},
     }
     config.model.remat = d_model >= 4096 if remat_env is None else remat_env == "1"
+    config.model.remat_policy = os.environ.get("BENCH_REMAT_POLICY", "full")
     # int8 decode KV cache ON by default for the bench: decode is HBM-bound
     # on cache reads, int8 halves that traffic (+6% samples/s at 2.0B) and
     # frees HBM for a larger rollout chunk. Learning-quality verified: PPO
-    # randomwalks reaches 1.0 optimality with it (scores/training always run
-    # full precision; only the sampling-time cache is quantized).
+    # randomwalks reaches 1.0 optimality with it; training re-forwards are
+    # always full precision, and under fused rollout stats the stored
+    # behavior logprobs are the quantized sampler's own (≤0.008 from the fp
+    # recompute — tests/test_fused_rollout.py).
     config.model.kv_cache_quant = os.environ.get("BENCH_KV_QUANT", "1") == "1"
     if name.endswith("-bf16"):
         # Throughput benching at the largest HBM-fitting size: bf16 master
@@ -422,7 +477,7 @@ def run_one(cand, iters=None, orchestrator=True):
         out["peak_bf16_tflops"] = peak
         out["train_mfu_pct"] = round(100 * train_tflops / peak, 2)
         out["iter_mfu_pct"] = round(100 * iter_tflops / peak, 2)
-    if orchestrator:
+    if orchestrator and os.environ.get("BENCH_ORCH", "1") == "1":
         orch_out = bench_orchestrator(trainer, C, P, vocab)
         out["orchestrator"] = orch_out
         # Derived full-cadence throughput when rollouts go through the REAL
@@ -483,18 +538,9 @@ def bench_orchestrator(trainer, C, P, vocab):
         trainer.store.clear_history()
         t0 = time.time()
         for _ in range(n_chunks):
-            if fused:
-                tokens, mask, p_len, aux = orch._generate_next_chunk()
-            else:
-                # Same prompt pipeline as every other pass — the comparison
-                # must time identical work, not different prompt sets.
-                try:
-                    b = next(orch.pipeline_iterator)
-                except StopIteration:
-                    orch.pipeline_iterator = iter(orch.pipeline_loader)
-                    b = next(orch.pipeline_iterator)
-                tokens, mask = trainer.rollout_generate(b["input_ids"], b["attention_mask"])
-                p_len, aux = b["input_ids"].shape[1], None
+            # Same prompt pipeline as every other pass — the comparison must
+            # time identical work, not different prompt sets.
+            tokens, mask, p_len, aux = orch._generate_next_chunk(fused=fused)
             sync(tokens)
             tokens_h, mask_h = trainer.to_local_host((tokens, mask))
             scores = np.asarray(reward_fn(trainer.decode(tokens_h, mask_h)), np.float32)
@@ -544,5 +590,23 @@ def bench_orchestrator(trainer, C, P, vocab):
     return out
 
 
+def _main_one(payload: str):
+    """Subprocess entry: run ONE size candidate, print its JSON; exit
+    OOM_EXIT_CODE on allocator failure so the parent tries the next size
+    with a clean device."""
+    _setup_compile_cache()
+    spec = json.loads(payload)
+    try:
+        result = run_one(tuple(spec["cand"]), **spec["kwargs"])
+    except Exception as e:
+        if is_oom(e):
+            sys.exit(OOM_EXIT_CODE)
+        raise
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        _main_one(sys.argv[2])
+        sys.exit(0)
     sys.exit(main())

@@ -52,6 +52,8 @@ class ModelConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
+    # "full" | "dots" — see LMConfig.remat_policy.
+    remat_policy: str = "full"
     # int8 decode KV cache (halves cache HBM traffic + memory; see
     # LMConfig.kv_cache_quant). Off by default.
     kv_cache_quant: bool = False

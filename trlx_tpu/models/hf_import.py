@@ -41,6 +41,7 @@ def build_lm_config(config) -> LMConfig:
         dtype=mc.dtype,
         param_dtype=mc.param_dtype,
         remat=mc.remat,
+        remat_policy=getattr(mc, "remat_policy", "full"),
         kv_cache_quant=getattr(mc, "kv_cache_quant", False),
     )
     if mc.model_arch:

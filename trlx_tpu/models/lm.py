@@ -83,7 +83,20 @@ class LMConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = False
+    # remat granularity: "full" recomputes everything in the block (minimum
+    # memory); "dots" saves matmul outputs with no batch dims (weight-matmul
+    # results survive, attention scores recompute) — more memory, less
+    # backward recompute. Only read when remat=True.
+    remat_policy: str = "full"
     extra: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # Validate at construction, not first use: a typo'd policy on a
+        # config where remat happens to be off must not silently no-op.
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} (expected 'full' or 'dots')"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -486,7 +499,12 @@ class TransformerLM(nn.Module):
             # (`if use_ring:`) — they must stay STATIC under remat tracing or
             # TracerBoolConversionError fires on the flash/ring paths.
             # Argnums count self as 0: x=1 ... window=7, use_ring=8.
-            block_cls = nn.remat(Block, prevent_cse=False, static_argnums=(7, 8))
+            policy = None
+            if cfg.remat_policy == "dots":  # validated in LMConfig.__post_init__
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block_cls = nn.remat(
+                Block, prevent_cse=False, static_argnums=(7, 8), policy=policy
+            )
 
         branch_hidden = None
         new_cache = [] if cache is not None else None

@@ -45,18 +45,22 @@ class PPOOrchestrator(Orchestrator):
         (reference: trlx/orchestrator/ppo_orchestrator.py:45-49)."""
         return self.rl_model.reward_fn(texts)
 
-    def _generate_next_chunk(self):
+    def _generate_next_chunk(self, fused=None):
+        """`fused=None` follows the trainer's fused_rollout setting; False
+        forces the plain generate+recompute path (benchmark baselines)."""
         try:
             batch = next(self.pipeline_iterator)
         except StopIteration:
             self.pipeline_iterator = iter(self.pipeline_loader)
             batch = next(self.pipeline_iterator)
         P = batch["input_ids"].shape[1]
+        if fused is None:
+            fused = getattr(self.rl_model, "fused_rollout", False)
         # Dispatched, not awaited: jax queues the compiled prefill+decode
         # program and returns immediately. With fused rollout stats the same
         # program also emits the policy logprobs/values/branch-hiddens the
         # scorer needs (aux), so scoring is a ref-branch replay only.
-        if getattr(self.rl_model, "fused_rollout", False):
+        if fused:
             tokens, mask, stats, prefill = self.rl_model.rollout_generate_fused(
                 batch["input_ids"], batch["attention_mask"]
             )
