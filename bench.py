@@ -212,12 +212,13 @@ def main():
     # even the tiny size fails), so in-process fallback would poison every
     # subsequent size. CPU dev runs stay in-process (no such leak; subprocess
     # jax re-init would dominate).
-    nonlocal_use_subproc = [
+    use_subproc = (
         jax.default_backend() == "tpu" and os.environ.get("BENCH_SUBPROC", "1") == "1"
-    ]
+    )
 
     def try_one(cand, **kwargs):
-        if not nonlocal_use_subproc[0]:
+        nonlocal use_subproc
+        if not use_subproc:
             try:
                 return run_one(cand, **kwargs)
             except Exception as e:
@@ -237,16 +238,22 @@ def main():
             capture_output=True,
             text=True,
         )
-        if proc.returncode == OOM_EXIT_CODE:
+        if proc.returncode == OOM_EXIT_CODE or proc.returncode < 0:
+            # OOM exit, or the runtime hard-aborted the child (SIGABRT from a
+            # native allocator failure never reaches the Python handler) —
+            # either way this size doesn't fit; keep the attempt debuggable.
+            sys.stderr.write(proc.stderr[-1500:])
             return None
         if proc.returncode != 0:
             # Standard TPU VMs hold libtpu exclusively per process: the
             # parent's backend probe already claimed the device, so children
             # can't. Fall back to in-process attempts there (the axon
             # tunneled backend, where subprocess isolation is REQUIRED for
-            # OOM recovery, has no such exclusivity).
-            if "already in use" in proc.stderr or "libtpu" in proc.stderr.lower():
-                nonlocal_use_subproc[0] = False
+            # OOM recovery, has no such exclusivity). Keyed on the SPECIFIC
+            # exclusivity message — a generic libtpu mention also appears in
+            # ordinary abort logs and must not disable isolation.
+            if "already in use" in proc.stderr:
+                use_subproc = False
                 print(
                     "bench: TPU is process-exclusive here — falling back to "
                     "in-process size attempts",
@@ -255,6 +262,8 @@ def main():
                 return try_one(cand, **kwargs)
             sys.stderr.write(proc.stderr[-4000:])
             raise RuntimeError(f"bench subprocess failed for {cand[0]} (rc={proc.returncode})")
+        if proc.stderr.strip():
+            sys.stderr.write(proc.stderr[-1500:])
         return json.loads(proc.stdout.strip().splitlines()[-1])
 
     def first_fitting(cands, **kwargs):
