@@ -75,3 +75,30 @@ def test_sentiment_score_shapes():
     assert sentiment_score(top1) == [0.9, pytest.approx(0.2)]
     all_scores = [[{"label": "NEGATIVE", "score": 0.3}, {"label": "POSITIVE", "score": 0.7}]]
     assert sentiment_score(all_scores) == [pytest.approx(0.7)]
+
+
+def test_indivisible_batch_and_chunk_fail_at_construction(tmp_path):
+    """Batch/chunk sizes that cannot shard over the mesh's data axes must
+    fail at trainer construction with a clear message, not as a cryptic
+    sharding error at the first put_batch."""
+    import os
+    import sys
+
+    import pytest
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+    from randomwalks import base_config
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.mesh = [8, 1, 1, 1]
+    config.train.batch_size = 12  # 12 % 8 != 0
+    config.method.chunk_size = 16  # valid, so the error is the BATCH check's
+    with pytest.raises(ValueError, match="train.batch_size"):
+        PPOTrainer(config)
+
+    config.train.batch_size = 16
+    config.method.chunk_size = 20  # 20 % 8 != 0
+    with pytest.raises(ValueError, match="chunk_size"):
+        PPOTrainer(config)

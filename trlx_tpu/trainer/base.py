@@ -52,6 +52,16 @@ class JaxBaseTrainer(BaseRLTrainer):
         set_mesh(self.mesh)
         barrier()  # ≈ reference's init barrier (trlx/model/accelerate_base_model.py:33-34)
 
+        # Fail misconfigured batch/mesh combinations HERE — before the
+        # expensive model build / checkpoint restore — with a clear message
+        # instead of a cryptic sharding error at the first put_batch. Sizes
+        # are rows per PROCESS (the reference's per-rank semantics); the
+        # assembled global batch must shard evenly over the data axes.
+        self._validate_data_sharding(config.train.batch_size, "train.batch_size")
+        chunk = getattr(config.method, "chunk_size", None)
+        if chunk is not None:
+            self._validate_data_sharding(chunk, "method.chunk_size (rollout chunk)")
+
         self.rng = jax.random.PRNGKey(config.train.seed)
         self.tokenizer = self._build_tokenizer(config.model.tokenizer_path)
 
@@ -87,6 +97,20 @@ class JaxBaseTrainer(BaseRLTrainer):
         self.iter_count = 0
 
     # ------------------------------------------------------------------ setup
+
+    def _validate_data_sharding(self, rows_per_process: int, name: str):
+        """Per-process row counts globalize to rows × process_count and shard
+        over the SAME data axes put_batch uses (DATA_AXES) — validate against
+        exactly that product so the check cannot drift from the sharding."""
+        data = int(np.prod([self.mesh.shape[a] for a in DATA_AXES]))
+        global_rows = rows_per_process * jax.process_count()
+        if global_rows % data:
+            raise ValueError(
+                f"{name}={rows_per_process} × {jax.process_count()} "
+                f"process(es) = {global_rows} global rows, which does not "
+                f"divide the mesh's data axes {DATA_AXES}={data} — pick a "
+                "size that shards evenly"
+            )
 
     def _build_tokenizer(self, tokenizer_path: str):
         if not tokenizer_path:
