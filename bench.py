@@ -357,6 +357,11 @@ def run_one(cand, iters=None, orchestrator=True):
     # behavior logprobs are the quantized sampler's own (≤0.008 from the fp
     # recompute — tests/test_fused_rollout.py).
     config.model.kv_cache_quant = os.environ.get("BENCH_KV_QUANT", "1") == "1"
+    # W8A16 decode (int8 trunk kernels for sampling only): measured −18..21%
+    # decode time (BASELINE.md), but the int8 copies cost ~+2.3 GB at 2.0B so
+    # the default chunk-48 flagship no longer fits — default off; enable with
+    # BENCH_W8=1 (pair with BENCH_CHUNK=32 at 2.0B).
+    config.model.decode_weight_quant = os.environ.get("BENCH_W8", "0") == "1"
     if name.endswith("-bf16"):
         # Throughput benching at the largest HBM-fitting size: bf16 master
         # params + moments (named honestly in the metric). Production fp32-

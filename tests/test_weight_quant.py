@@ -1,0 +1,158 @@
+"""W8A16 decode: int8 weight-only trunk kernels for rollout sampling.
+QDense without the `qw` collection must be exactly nn.Dense (the whole
+existing suite pins that); these tests cover the quantized path."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.models import TransformerLM  # noqa: E402
+from trlx_tpu.models.lm import LMConfig, quantize_weights  # noqa: E402
+
+
+def _tiny_cfg():
+    return LMConfig.from_dict(
+        dict(
+            vocab_size=97, n_layer=2, n_head=4, d_model=64, max_position=64,
+            pos_type="rotary", rotary_dim=8, parallel_residual=True,
+            fused_qkv=False, qkv_bias=False, out_bias=False,
+            tie_word_embeddings=False, activation="gelu_new",
+        )
+    )
+
+
+def test_quantized_logits_close_and_structure():
+    """`qw` collection: every trunk matmul kernel gets an int8 copy +
+    per-output-channel scale; logits with quantized weights stay close to
+    full precision (W8 per-channel is near-lossless)."""
+    cfg = _tiny_cfg()
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, size=(2, 12)))
+    mask = jnp.ones_like(ids)
+    params = model.init(jax.random.PRNGKey(0), ids, mask)["params"]
+
+    qw = quantize_weights(params)
+    # structure: per-layer attn/mlp kernels + lm_head, int8 + f32 scales
+    assert qw["h_0"]["attn"]["q_proj"]["kernel_q"].dtype == jnp.int8
+    assert qw["h_0"]["mlp"]["c_fc"]["scale"].shape == (cfg.ff_dim,)
+    assert "lm_head" in qw
+    assert "wte" not in qw and "ln_f" not in qw  # embeddings/norms stay fp
+
+    full = model.apply({"params": params}, ids, mask)["logits"]
+    quant = model.apply({"params": params, "qw": qw}, ids, mask)["logits"]
+    full, quant = np.asarray(full, np.float32), np.asarray(quant, np.float32)
+    assert not np.array_equal(full, quant)  # the int8 path actually ran
+    # near-lossless: small absolute logit perturbation relative to the range
+    denom = np.abs(full).max()
+    assert np.abs(quant - full).max() / denom < 0.05, (
+        np.abs(quant - full).max(), denom
+    )
+
+
+def test_w8_decode_learning_gate(tmp_path):
+    """Learning-quality gate with W8A16 decode ON (+ fused stats + int8 KV —
+    the full quantized sampling stack): randomwalks must still reach ≥0.8
+    optimality; the stored behavior logprobs are the quantized sampler's
+    own, so PPO stays on-policy by construction."""
+    n_nodes, max_length = 21, 10
+    walks, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=n_nodes, max_length=max_length
+    )
+    config = base_config("ppo", n_nodes, max_length)
+    config.train.total_steps = 48
+    config.train.eval_interval = 16
+    config.train.checkpoint_interval = 10**6
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 48
+    config.model.num_layers_unfrozen = 1
+    config.model.kv_cache_quant = True
+    config.model.decode_weight_quant = True
+    config.method.num_rollouts = 96
+    config.method.chunk_size = 48
+
+    history = []
+
+    def gated_metric(samples):
+        m = metric_fn(samples)
+        history.append(float(np.mean(m["optimality"])))
+        return m
+
+    prompts = [[int(np.random.default_rng(i).integers(1, n_nodes))] for i in range(96)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts,
+        eval_prompts=[[i] for i in range(1, n_nodes)], metric_fn=gated_metric,
+        config=config, logit_mask=logit_mask,
+    )
+    assert model._qw is not None  # the quantized path actually engaged
+    assert history and max(history) >= 0.8, f"W8-decode optimality history: {history}"
+
+
+def test_w8_requantizes_after_policy_update(tmp_path):
+    """The int8 decode kernels must track the LIVE policy: after training
+    steps + post_epoch_callback, the qw tree differs from the initial one."""
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = generate_random_walks(15, 8, 60, seed=1000)
+    config = base_config("ppo", 15, 8)
+    # total_steps must cross an epoch boundary (ppo_epochs=4 × 1 batch per
+    # epoch) so post_epoch_callback — where the re-quantize lives — fires.
+    config.train.total_steps = 6
+    config.train.epochs = 2
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.model.decode_weight_quant = True
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    initial_q = None
+
+    orig_refresh = PPOTrainer._refresh_decode_weights
+    changed = {"seen": False}
+
+    def spy(self):
+        nonlocal initial_q
+        if initial_q is None:
+            initial_q = np.asarray(self._qw["transformer"]["h_1"]["mlp"]["c_fc"]["kernel_q"]).copy()
+        orig_refresh(self)
+        if not np.array_equal(
+            np.asarray(self._qw["transformer"]["h_1"]["mlp"]["c_fc"]["kernel_q"]), initial_q
+        ):
+            changed["seen"] = True
+
+    PPOTrainer._refresh_decode_weights = spy
+    try:
+        trlx_tpu.train(
+            reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+            metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+        )
+    finally:
+        PPOTrainer._refresh_decode_weights = orig_refresh
+    assert changed["seen"], "decode kernels never re-quantized after updates"
+
+
+def test_w8_refused_without_fused_path(tmp_path):
+    """decode_weight_quant without the fused stats path (here: fully
+    unfrozen, no hydra branch) must be refused — unfused scoring would
+    recompute behavior logprobs at full precision against int8-sampled
+    tokens, a silent off-policy bias."""
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 16
+    config.method.chunk_size = 16
+    config.model.num_layers_unfrozen = -1
+    config.model.decode_weight_quant = True
+    with pytest.raises(ValueError, match="fused"):
+        PPOTrainer(config)

@@ -57,6 +57,10 @@ class ModelConfig:
     # int8 decode KV cache (halves cache HBM traffic + memory; see
     # LMConfig.kv_cache_quant). Off by default.
     kv_cache_quant: bool = False
+    # int8 weight-only decode (W8A16): rollout sampling reads int8 trunk
+    # kernels (re-quantized from the live policy before each rollout phase);
+    # training/scoring stay full precision. Off by default.
+    decode_weight_quant: bool = False
     reward_model_path: str = ""
     reward_model_arch: Dict[str, Any] = field(default_factory=dict)
 

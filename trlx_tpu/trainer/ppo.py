@@ -107,6 +107,18 @@ class PPOTrainer(JaxBaseTrainer):
         self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
         self._score_fn = jax.jit(partial(self._rollout_score_impl, prompt_length=self.prompt_length))
 
+        # W8A16 decode: int8 copies of the trunk matmul kernels ride along as
+        # the 'qw' variable collection; QDense reads them instead of the bf16
+        # masters, halving decode's dominant HBM term. Re-quantized from the
+        # LIVE policy before every rollout phase (post_epoch_callback) so the
+        # sampler never lags the optimizer.
+        self._qw = None
+        if getattr(config.model, "decode_weight_quant", False):
+            from trlx_tpu.models.lm import quantize_weights
+
+            self._quantize_fn = jax.jit(quantize_weights)
+            self._qw = self._quantize_fn(self.state.params)
+
         # Fused rollout statistics: the decode loop ALREADY computes every
         # policy quantity rollout scoring needs — raw logits of each sampled
         # token, the value head, and (hydra models) the branch-point hidden
@@ -129,6 +141,17 @@ class PPOTrainer(JaxBaseTrainer):
             and self.model.branch_layer >= 0
             and not config.model.has_reward_model
         )
+        if self._qw is not None and not self.fused_rollout:
+            raise ValueError(
+                "model.decode_weight_quant requires the fused rollout-stats "
+                "path (a hydra model with a host reward_fn and "
+                "method.fused_rollout_stats on): fused stats store the "
+                "QUANTIZED sampler's own logprobs, keeping PPO on-policy by "
+                "construction. Unfused scoring would recompute behavior "
+                "logprobs at full precision against int8-sampled tokens — a "
+                "silent off-policy bias. Disable decode_weight_quant or "
+                "enable the fused path."
+            )
         if self.fused_rollout:
 
             def rollout_stats_fn(tok, s):
@@ -273,9 +296,23 @@ class PPOTrainer(JaxBaseTrainer):
 
     # --------------------------------------------------------------- rollout
 
+    def _decode_variables(self):
+        """Variable collections for the decode programs: live params, plus
+        the int8 weight copies when W8A16 decode is on."""
+        v = {"params": self.state.params}
+        if self._qw is not None:
+            v["qw"] = self._qw
+        return v
+
+    def _refresh_decode_weights(self):
+        """Re-quantize the int8 decode kernels from the LIVE policy — called
+        before every rollout phase so the sampler never lags the optimizer."""
+        if self._qw is not None:
+            self._qw = self._quantize_fn(self.state.params)
+
     def rollout_generate(self, input_ids, attention_mask):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
-        return self._generate_fn({"params": self.state.params}, batch["i"], batch["m"], self.next_rng())
+        return self._generate_fn(self._decode_variables(), batch["i"], batch["m"], self.next_rng())
 
     def rollout_generate_fused(self, input_ids, attention_mask):
         """Generation that also emits the rollout statistics (sampled-token
@@ -284,7 +321,7 @@ class PPOTrainer(JaxBaseTrainer):
         rollout_score_fused."""
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
         return self._generate_fused_fn(
-            {"params": self.state.params}, batch["i"], batch["m"], self.next_rng()
+            self._decode_variables(), batch["i"], batch["m"], self.next_rng()
         )
 
     def _rollout_score_fused_impl(self, extras, tokens, mask, scores, kl_coef, logprob, value, bh_steps, bh_prefill, *, prompt_length: int):
@@ -451,6 +488,7 @@ class PPOTrainer(JaxBaseTrainer):
         """Alternate back to rollout
         (reference: trlx/model/accelerate_ppo_model.py:157-161)."""
         self._flush_kl_updates()  # rollout rewards consume kl_ctl.value
+        self._refresh_decode_weights()  # sampler follows the updated policy
         self.store.clear_history()
         self.orch.make_experience(self.config.method.num_rollouts, self.iter_count)
         self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
