@@ -110,6 +110,7 @@ def test_w8_requantizes_after_policy_update(tmp_path):
     config.train.batch_size = 16
     config.train.eval_interval = 100
     config.train.checkpoint_dir = str(tmp_path)
+    config.model.num_layers_unfrozen = 1  # hydra → fused path (W8 requires it)
     config.model.decode_weight_quant = True
     config.method.num_rollouts = 16
     config.method.chunk_size = 16
