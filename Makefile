@@ -1,9 +1,15 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test quality style bench
+.PHONY: test test-fast quality style bench bench-reference
 
+# Full suite (learning gates, multihost, kernels): nightly / pre-release.
 test:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	python -m pytest tests/ -q
+
+# Fast tier: per-commit CI signal, < ~3 min on CPU.
+test-fast:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -m pytest tests/ -q -m "not slow"
 
 quality:
 	ruff check trlx_tpu/ tests/ examples/ bench.py
@@ -13,3 +19,7 @@ style:
 
 bench:
 	python bench.py
+
+# CPU head-to-head vs the reference's own training loop (writes HEADTOHEAD.json).
+bench-reference:
+	python bench_reference.py

@@ -22,9 +22,16 @@ steps — and reports, alongside samples/s/chip:
 
 The default preset is "auto": the largest HBM-fitting entry from SIZES at
 seq 1024 (768-token prefill + 256-token decode), which routes scoring and
-training attention through the pallas flash kernel. The reference publishes
-no numbers and no measured Accelerate-GPU baseline exists in this
-environment (BASELINE.md), so vs_baseline is null — not a placeholder ratio.
+training attention through the pallas flash kernel. The HEADLINE value is
+the PRODUCTION cadence (pipelined + fused-scoring rollouts + measured train
+phase); the serialized-unfused phase loop is kept as
+`ablation_serialized_unfused_samples_per_sec_per_chip`. `decode_hbm_util_pct`
+states the "decode at its bandwidth floor" claim as a falsifiable percentage.
+
+vs_baseline: the reference publishes no numbers and no Accelerate-GPU
+baseline can run here (BASELINE.md), so the TPU-vs-GPU gate stays open; the
+ratio reported is the MEASURED CPU head-to-head against the reference's own
+training loop (bench_reference.py → HEADTOHEAD.json), scope-labeled.
 """
 
 import gc
@@ -61,6 +68,20 @@ FP32_SIZES = [
     ("gptj-l2-d1024-0.1B-fp32", 2, 1024, 16, 50400, 768, 256, 8, 1, 16),
 ]
 # Legacy fixed presets (BENCH_PRESET env) — the r1 shapes, kept comparable.
+# ILQL bench sizes: the reference's ILQL cadence is short-sequence offline
+# batches (seq 64, configs/ilql_config.yml:8) and the method trains ALL
+# layers + 4 vocab-wide Q heads (2 online + 2 target) — different memory
+# shape than PPO (full-trunk Adam moments + [B,T,vocab] Q tensors in the
+# loss), so the candidate list is its own. (name, L, d, heads, vocab, P, R,
+# B, unfrozen(-1=all), C unused)
+ILQL_SIZES = [
+    # d4096 at -1 unfrozen was dropped after measurement (r4): the tunneled
+    # backend's remote compile helper 500s on it deterministically (two
+    # same-size retries), burning ~6 min of bench budget before the fallback.
+    ("ilql-l4-d2048-0.4B-bf16", 4, 2048, 16, 50400, 16, 48, 32, -1, 32),
+    ("ilql-l2-d512-tiny", 2, 512, 8, 1024, 16, 48, 16, -1, 16),
+]
+
 PRESETS = {
     "tiny": ("gptj-l2-d256", 2, 256, 8, 1024, 16, 32, 16, 1, 16),
     "small": ("gptj-l8-d1024", 8, 1024, 16, 50400, 16, 32, 16, 4, 16),
@@ -88,6 +109,29 @@ def detect_peak_tflops():
         if key in kind:
             return peak, kind
     return None, kind
+
+
+# Peak HBM bandwidth (GB/s) per chip by device_kind substring — for the
+# decode_hbm_util_pct derivation (decode is the bandwidth-bound phase).
+HBM_GBPS = [
+    ("v5 lite", 819),
+    ("v5e", 819),
+    ("v5p", 2765),
+    ("v6", 1638),
+    ("v4", 1228),
+    ("v3", 900),
+    ("v2", 700),
+]
+
+
+def detect_hbm_gbps():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, bw in HBM_GBPS:
+        if key in kind:
+            return bw
+    return None
 
 
 # HBM per chip by device_kind substring, for environments (like the tunneled
@@ -120,23 +164,37 @@ def hbm_bytes():
     return None
 
 
-def is_oom(e: Exception) -> bool:
-    """Robust allocator-failure detection for the auto-size fallback: match
-    the jaxlib error type when available, else a broad substring net —
-    differently-worded allocator errors must try the next size, not abort."""
-    try:
-        from jax.errors import JaxRuntimeError
+# Allocator-specific phrases only: a bare 'alloc'/'memory'/'hbm' net would
+# classify unrelated runtime errors ('invalid memory access', layout/allocation
+# asserts) as OOM and silently fall back to a smaller size.
+_OOM_PHRASES = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "failed to allocate",
+    "allocation failed",
+    "oom",
+)
 
-        if isinstance(e, JaxRuntimeError) and any(
-            s in str(e).lower() for s in ("alloc", "exhausted", "memory", "oom", "hbm")
-        ):
-            return True
-    except ImportError:
-        pass
+
+def is_transient_compile_failure(e: Exception) -> bool:
+    """The tunneled backend's remote compile service can 500 transiently
+    (tpu_compile_helper subprocess failures). Those deserve ONE same-size
+    retry — falling straight back to a smaller size would silently shrink
+    the flagship measurement."""
     msg = str(e).lower()
-    return any(
-        s in msg for s in ("resource_exhausted", "out of memory", "exhausted", "alloc", "oom", "hbm")
-    )
+    return "remote_compile" in msg and "http 5" in msg
+
+
+def is_oom(e: Exception) -> bool:
+    """Allocator-failure detection for the auto-size fallback. The classified
+    error is logged to stderr so a misclassification is visible in the bench
+    transcript rather than silently becoming a smaller model size."""
+    msg = str(e).lower()
+    hit = any(s in msg for s in _OOM_PHRASES)
+    if hit:
+        print(f"[bench] classified as OOM ({type(e).__name__}): {str(e)[:500]}", file=sys.stderr)
+    return hit
 
 
 def fits_hbm(L, d, vocab, unfrozen, hbm, param_bytes=2):
@@ -293,6 +351,48 @@ def main():
                 )
                 if k in fp32
             }
+
+    # ILQL measured point (the reference ships two methods; both get a perf
+    # story). Heads add ~4x(2d*V) params over the PPO config, so the fitting
+    # size may be smaller — the same OOM-fallback machinery sizes it.
+    if os.environ.get("BENCH_ILQL_POINT", "1") == "1":
+        gc.collect()
+        ilql_candidates = ILQL_SIZES if preset == "auto" else [ILQL_SIZES[-1]]
+        if jax.default_backend() != "tpu":
+            ilql_candidates = [ILQL_SIZES[-1]]
+        ilql = first_fitting(ilql_candidates, mode="ilql", iters=2)
+        if ilql is not None:
+            result["ilql_point"] = ilql
+
+    # The first MEASURED baseline ratio: bench_reference.py runs the
+    # reference's OWN trlx.train head-to-head against trlx_tpu on CPU
+    # (identical dataset + protocol, the reference's own metric) and records
+    # HEADTOHEAD.json. Scope-labeled — a same-hardware implementation ratio,
+    # NOT the v4-32 ≥2x gate (which needs hardware this environment lacks).
+    h2h_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "HEADTOHEAD.json")
+    if os.path.exists(h2h_path):
+        # Assemble in a temp dict so a malformed file leaves `result`
+        # untouched (vs_baseline really does stay null on any failure).
+        try:
+            with open(h2h_path) as f:
+                h2h = json.load(f)
+            fields = {
+                "vs_baseline": h2h["vs_baseline_samples_per_s"],
+                "vs_baseline_scope": (
+                    "CPU head-to-head vs the reference's own training loop "
+                    "(randomwalks ILQL, identical dataset/protocol/metric — "
+                    "HEADTOHEAD.json; cold-compile included). Warm-cache: "
+                    f"{h2h.get('vs_baseline_warm_cache')}, full-step steady-state: "
+                    f"{h2h.get('vs_baseline_steady_state')}. Not the v4-32 gate."
+                ),
+                "vs_baseline_final_optimality": {
+                    "reference": h2h["reference"]["final_optimality"],
+                    "ours": h2h["ours"]["final_optimality"],
+                },
+            }
+            result.update(fields)
+        except (KeyError, ValueError, TypeError) as e:
+            print(f"bench: HEADTOHEAD.json unreadable ({e}); vs_baseline stays null", file=sys.stderr)
     print(json.dumps(result))
 
 
@@ -308,8 +408,11 @@ def device_sync(tree):
     np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
 
 
-def run_one(cand, iters=None, orchestrator=True):
+def run_one(cand, iters=None, orchestrator=True, mode="ppo"):
     import jax
+
+    if mode == "ilql":
+        return run_one_ilql(cand, iters=iters)
 
     name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen, C = cand
     # Tuning knobs (experimentation; the shipped SIZES carry the defaults).
@@ -491,17 +594,66 @@ def run_one(cand, iters=None, orchestrator=True):
         out["peak_bf16_tflops"] = peak
         out["train_mfu_pct"] = round(100 * train_tflops / peak, 2)
         out["iter_mfu_pct"] = round(100 * iter_tflops / peak, 2)
+
+    # ---- decode HBM utilization (the falsifiable form of "decode runs at
+    # its bandwidth floor"): modeled bytes the decode loop must move —
+    # weights re-read every step + growing KV-cache reads/writes — over the
+    # measured decode seconds. Decode time = generate phase minus a modeled
+    # prefill (prefill FLOPs at the measured TRAIN MFU — both are
+    # large-batch matmul phases). 100% ≈ the roofline; the gap is the
+    # remaining W8/int8-KV headroom.
+    bw_gbps = detect_hbm_gbps()
+    if bw_gbps and peak and t_gen > 0:
+        w8 = bool(config.model.decode_weight_quant)
+        wb = 1.0 if w8 else 2.0  # int8 trunk kernels vs bf16
+        kvb = 1.0 if config.model.kv_cache_quant else 2.0
+        # per-step weight reads: trunk matmuls + lm_head (batch C shares one
+        # read); wte is a C-row gather — negligible.
+        step_weight_bytes = (L * 12 * d * d + V * d) * wb
+        # KV reads grow P→T over the R steps (keys+values), one write/step.
+        kv_bytes = C * L * 2 * d * kvb * (R * (P + T) / 2 + R)
+        decode_bytes = R * step_weight_bytes + kv_bytes
+        prefill_flops = lm_flops(L, d, V, C * P, P / 2, C)
+        mfu = max(train_tflops / peak, 1e-3)
+        t_prefill = prefill_flops / (peak * 1e12 * mfu)
+        t_decode = max(t_gen / iters - t_prefill, 1e-6)
+        out["decode_hbm_util_pct"] = round(
+            100.0 * decode_bytes / t_decode / (bw_gbps * 1e9), 1
+        )
+        out["decode_hbm_model"] = {
+            "peak_hbm_gbps": bw_gbps,
+            "decode_seconds_modeled": round(t_decode, 3),
+            "prefill_seconds_modeled": round(t_prefill, 3),
+            "weight_bytes_per_step_gb": round(step_weight_bytes / 1e9, 3),
+            "kv_bytes_total_gb": round(kv_bytes / 1e9, 3),
+        }
     if orchestrator and os.environ.get("BENCH_ORCH", "1") == "1":
         orch_out = bench_orchestrator(trainer, C, P, vocab)
         out["orchestrator"] = orch_out
-        # Derived full-cadence throughput when rollouts go through the REAL
-        # pipelined (+fused) orchestrator path instead of the serialized
-        # phase loop the primary metric uses: chunk rollout time from the
-        # orchestrator measurement + the measured train phase.
+        # THE HEADLINE IS THE PRODUCTION PATH: full-cadence throughput with
+        # rollouts going through the REAL pipelined (+fused) orchestrator —
+        # chunk rollout time from the orchestrator measurement + the measured
+        # train phase. The serialized-phase loop measured above (unfused
+        # scorer, full sync between phases) is kept as the ablation field.
         rollout_s = C / max(orch_out["samples_per_sec_per_chip"] * n_chips, 1e-9)
-        out["production_samples_per_sec_per_chip"] = round(
-            C / (rollout_s + t_train / iters) / n_chips, 3
+        production = C / (rollout_s + t_train / iters) / n_chips
+        out["ablation_serialized_unfused_samples_per_sec_per_chip"] = out["value"]
+        out["value"] = round(production, 3)
+        out["metric"] = out["metric"].replace(
+            "ppo_samples_per_sec_per_chip", "ppo_production_samples_per_sec_per_chip"
         )
+        # iteration MFU at the production cadence. With fused stats the
+        # scoring pass is a ref-branch replay only — model THAT flop count,
+        # not the unfused full re-forward, so the MFU is not inflated by a
+        # faster wall clock against phantom FLOPs.
+        if peak:
+            if orch_out.get("fused_rollout_stats"):
+                prod_score_flops = lm_flops(unfrozen, d, V, C * T, kv_train, C * resp)
+            else:
+                prod_score_flops = score_flops
+            prod_flops = gen_flops + prod_score_flops + train_flops
+            prod_iter_tflops = prod_flops / max(rollout_s + t_train / iters, 1e-9) / n_chips / 1e12
+            out["production_iter_mfu_pct"] = round(100 * prod_iter_tflops / peak, 2)
     return out
 
 
@@ -604,6 +756,137 @@ def bench_orchestrator(trainer, C, P, vocab):
     return out
 
 
+def run_one_ilql(cand, iters=None):
+    """ILQL full-cadence bench (the reference's second method had no perf
+    story until now — capability: trlx/model/accelerate_ilql_model.py:50-156,
+    trlx/model/nn/ilql_models.py:162-251):
+
+    - train samples/s/chip + modeled MFU over the jitted ILQL step (trunk +
+      double vocab-wide Q heads + target heads + V head + AWAC logits) at
+      the reference cadence incl. the jitted Polyak target sync every
+      `steps_for_target_q_sync` steps,
+    - advantage-steered decode tokens/s/chip (target-Q steering
+      `pi_beta + beta*(Q−V)`, top-k, in-loop stat collection).
+
+    Dataset is synthetic full-length token rows (compute, not learning, is
+    under measurement; learning gates live in tests/test_e2e.py)."""
+    import jax
+
+    from trlx_tpu.orchestrator.offline_orchestrator import OfflineOrchestrator
+    from trlx_tpu.trainer.api import default_config
+    from trlx_tpu.trainer.ilql import ILQLTrainer
+
+    name, n_layer, d_model, n_head, vocab, P, R, B, unfrozen, C = cand
+    # ILQL-specific knobs (the BENCH_PROMPT/BENCH_DECODE PPO knobs don't
+    # apply — ILQL's cadence is short-sequence offline, ILQL_SIZES).
+    B = int(os.environ.get("BENCH_ILQL_BATCH", B))
+    n_dev = jax.device_count()
+    B = ((B + n_dev - 1) // n_dev) * n_dev
+    T = P + R
+
+    config = default_config("ilql")
+    config.model.model_path = ""
+    config.model.tokenizer_path = ""
+    config.model.num_layers_unfrozen = -1  # reference ILQL default: all train
+    config.model.model_arch = {
+        "vocab_size": vocab,
+        "n_layer": n_layer,
+        "n_head": n_head,
+        "d_model": d_model,
+        "max_position": max(2048, T),
+        "eos_token_id": 0,
+        "pos_type": "rotary",
+        "rotary_dim": 64 if d_model // n_head >= 64 else d_model // n_head,
+        "parallel_residual": True,
+        "fused_qkv": False,
+        "qkv_bias": False,
+        "out_bias": False,
+        "tie_word_embeddings": False,
+        "extra": {"lm_head_bias": True},
+    }
+    config.model.remat = d_model >= 4096 if os.environ.get("BENCH_REMAT") is None else os.environ.get("BENCH_REMAT") == "1"
+    config.model.kv_cache_quant = os.environ.get("BENCH_KV_QUANT", "1") == "1"
+    if name.endswith("-bf16"):
+        config.model.param_dtype = "bfloat16"
+    config.train.batch_size = B
+    config.train.seq_length = T
+    config.train.mesh = [-1, 1, 1, 1]
+    config.method.gen_kwargs = {
+        "prompt_length": P,
+        "max_new_tokens": R,
+        "min_new_tokens": R,
+        "top_k": 20,
+    }
+    trainer = ILQLTrainer(config)
+
+    rng = np.random.default_rng(0)
+    samples = [rng.integers(2, vocab, size=(T,)).astype(np.int32) for _ in range(2 * B)]
+    rewards = rng.normal(size=(2 * B,)).astype(np.float32).tolist()
+    OfflineOrchestrator(trainer).make_experience(samples, rewards)
+    batch = next(iter(trainer.store.create_loader(B, shuffle=True)))
+    device_batch = trainer.put_batch(batch)
+
+    sync_every = max(int(config.method.steps_for_target_q_sync), 1)
+
+    def train_steps(n):
+        for _ in range(n):
+            trainer.state, stats = trainer.train_step(trainer.state, device_batch)
+            trainer.iter_count += 1
+            trainer.post_backward_callback(stats)  # Polyak sync at cadence
+        device_sync(trainer.state.params)
+
+    train_steps(1)  # compile
+    steps = (iters if iters is not None else int(os.environ.get("BENCH_ITERS", "3"))) * max(
+        4, sync_every
+    )
+    t0 = time.time()
+    train_steps(steps)
+    t_train = time.time() - t0
+
+    prompt_ids = rng.integers(2, vocab, size=(B, P)).astype(np.int32)
+    pmask = np.ones((B, P), dtype=np.int32)
+    tokens, _ = trainer.rollout_generate(prompt_ids, pmask)  # compile
+    device_sync(tokens)
+    dec_iters = 2
+    t0 = time.time()
+    for _ in range(dec_iters):
+        tokens, _ = trainer.rollout_generate(prompt_ids, pmask)
+        device_sync(tokens)
+    t_dec = (time.time() - t0) / dec_iters
+
+    n_chips = jax.device_count()
+    sps_per_chip = steps * B / t_train / n_chips
+    decode_tps_per_chip = B * R / t_dec / n_chips
+
+    # ---- modeled FLOPs. Per-token head MACs (d→2d→vocab MLP): online Q
+    # heads train (fwd+bwd ≈ 3x fwd), target heads are fwd-only, V head
+    # trains; trunk is fully trainable here (num_layers_unfrozen = -1).
+    L, d, V = n_layer, d_model, vocab
+    mac_q = 2 * d * d + 2 * d * V
+    mac_v = 2 * d * d + 2 * d
+    trunk_fwd = lm_flops(L, d, V, B * T, T / 2, B * T)
+    # trunk fwd+bwd ≈ 3x fwd; heads: 2 online Q at 3x, 2 target Q at 1x
+    # (fwd only, no grads), V head at 3x — all per token, x2 FLOP/MAC.
+    step_flops = 3.0 * trunk_fwd + 2.0 * B * T * (3 * 2 * mac_q + 1 * 2 * mac_q + 3 * mac_v)
+    train_tflops = step_flops * steps / max(t_train, 1e-9) / n_chips / 1e12
+
+    peak, kind = detect_peak_tflops()
+    out = {
+        "metric": f"ilql_train_samples_per_sec_per_chip[{name},seq{T},b{B}]",
+        "value": round(sps_per_chip, 3),
+        "unit": "samples/s/chip",
+        "device_kind": kind,
+        "ilql_decode_tokens_per_s_per_chip": round(decode_tps_per_chip, 1),
+        "decode_seconds_per_batch": round(t_dec, 3),
+        "train_seconds_per_step": round(t_train / steps, 4),
+        "target_q_sync_every": sync_every,
+        "ilql_train_tflops_per_chip": round(train_tflops, 2),
+    }
+    if peak:
+        out["ilql_train_mfu_pct"] = round(100 * train_tflops / peak, 2)
+    return out
+
+
 def _main_one(payload: str):
     """Subprocess entry: run ONE size candidate, print its JSON; exit
     OOM_EXIT_CODE on allocator failure so the parent tries the next size
@@ -613,6 +896,16 @@ def _main_one(payload: str):
     try:
         result = run_one(tuple(spec["cand"]), **spec["kwargs"])
     except Exception as e:
+        if is_transient_compile_failure(e):
+            print("bench: transient remote-compile failure; retrying this size once", file=sys.stderr)
+            try:
+                result = run_one(tuple(spec["cand"]), **spec["kwargs"])
+            except Exception as e2:
+                if is_oom(e2):
+                    sys.exit(OOM_EXIT_CODE)
+                raise
+            print(json.dumps(result))
+            return
         if is_oom(e):
             sys.exit(OOM_EXIT_CODE)
         raise

@@ -23,3 +23,19 @@ jax.config.update("jax_platforms", "cpu")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # Two-tier suite (the reference's whole suite is one 46-LoC file and runs
+    # per-push, reference: .github/workflows/build.yml:33-41; this repo's suite
+    # outgrew a per-commit budget, so the fast tier is the per-commit signal):
+    #   make test-fast  → -m "not slow"  (< ~3 min CPU)
+    #   make test       → everything     (nightly / pre-release)
+    config.addinivalue_line(
+        "markers",
+        "slow: learning-gate / e2e / multihost / pallas-kernel tests; excluded by `make test-fast`",
+    )
+    config.addinivalue_line(
+        "markers",
+        "network: needs internet + HF checkpoint downloads; skipped unless TRLX_TPU_NETWORK=1 (see RUNBOOK.md)",
+    )

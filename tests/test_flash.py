@@ -11,6 +11,8 @@ import jax.numpy as jnp
 from trlx_tpu.models import LMConfig, TransformerLM
 from trlx_tpu.ops.flash_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 def ref_attn(q, k, v, kvmask, scale, window=0):
     T = q.shape[1]

@@ -14,6 +14,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
 import trlx_tpu  # noqa: E402
 from randomwalks import base_config, generate_random_walks  # noqa: E402
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 @pytest.fixture(scope="module")
 def task():

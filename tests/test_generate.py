@@ -10,6 +10,10 @@ from trlx_tpu.models import LMConfig, LMWithValueHead
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.sampling import GenerateConfig, top_p_mask, process_logits_default, NEG_INF
 
+import pytest
+
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 def setup_model():
     cfg = LMConfig(vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=64, dtype="float32")

@@ -19,6 +19,8 @@ torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
 from trlx_tpu.models import TransformerLM
+
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
 from trlx_tpu.models.hf_import import (
     LazySafetensors,
     lm_config_from_hf,

@@ -13,6 +13,8 @@ from trlx_tpu.models import LMConfig, LMWithValueHead
 from trlx_tpu.parallel import make_mesh, match_partition_rules, lm_partition_rules, shard_pytree, batch_sharding
 from trlx_tpu.parallel.mesh import resolve_mesh_shape
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 def test_device_count():
     assert jax.device_count() == 8
@@ -122,3 +124,34 @@ def test_sharded_generation_matches_single_device():
     finally:
         set_mesh(prior)  # restore the exact prior global (possibly None)
     np.testing.assert_array_equal(np.asarray(ref_toks), np.asarray(toks))
+
+
+@pytest.mark.slow
+def test_dryrun_all_four_axes_16_devices():
+    """All four mesh axes >1 simultaneously ({dp:2, fsdp:2, tp:2, sp:2} on 16
+    virtual devices): the full PPO + on-device-RM + fused + ILQL dry run.
+    Subprocess because this pytest process is pinned to 8 virtual devices
+    (conftest) and the device count is fixed at backend init."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=16").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"), "dryrun", "16"],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "'dp': 2, 'fsdp': 2, 'tp': 2, 'sp': 2" in proc.stdout, proc.stdout

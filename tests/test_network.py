@@ -1,0 +1,128 @@
+"""Network-gated acceptance suite — the reference's de-facto acceptance bar
+(its examples running to reward improvement, reference:
+examples/ppo_sentiments.py:10-26, README.md:22-43) as executable gates.
+
+Skipped unless TRLX_TPU_NETWORK=1: each test downloads HF checkpoints +
+datasets (lvwerra/gpt2-imdb, lvwerra/distilbert-imdb, imdb, EleutherAI/gpt-j-6B)
+and runs minutes-to-hours depending on hardware. See RUNBOOK.md for the
+one-command-per-config invocations and the day-one calibration notes.
+
+Pass criterion: ABSOLUTE threshold or IMPROVEMENT over the run's own first
+eval — robust to the unmeasured starting point of each checkpoint.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+NETWORK = os.environ.get("TRLX_TPU_NETWORK") == "1"
+
+pytestmark = [
+    pytest.mark.network,
+    pytest.mark.skipif(not NETWORK, reason="needs network + HF downloads (set TRLX_TPU_NETWORK=1)"),
+]
+
+
+def _trajectory(checkpoint_dir, key):
+    """All values of `key` logged to the run's metrics.jsonl, in order."""
+    vals = []
+    with open(os.path.join(checkpoint_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if key in rec:
+                vals.append(float(rec[key]))
+    return vals
+
+
+def _assert_learned(vals, absolute, improvement, what):
+    assert vals, f"no {what} evals were logged"
+    first, best = vals[0], max(vals)
+    assert best >= absolute or best >= first + improvement, (
+        f"{what}: first={first:.3f} best={best:.3f} — neither the absolute "
+        f"gate ({absolute}) nor +{improvement} improvement was reached; "
+        f"trajectory={['%.3f' % v for v in vals]}"
+    )
+
+
+def test_ppo_sentiments(tmp_path):
+    """gpt2-imdb + distilbert sentiment reward (reference acceptance config:
+    configs/ppo_config.yml). Gate: mean positive-sentiment score reaches 0.8,
+    or improves ≥0.15 over the run's own first eval."""
+    from datasets import load_dataset
+
+    import ppo_sentiments
+    import trlx_tpu
+    from trlx_tpu.trainer.api import default_config
+
+    config = default_config("ppo")
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = int(os.environ.get("TRLX_TPU_NETWORK_STEPS", 400))
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+    trlx_tpu.train(
+        "lvwerra/gpt2-imdb",
+        reward_fn=ppo_sentiments.build_reward_fn(),
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        config=config,
+    )
+    _assert_learned(_trajectory(str(tmp_path), "mean_reward"), 0.8, 0.15, "ppo_sentiments mean_reward")
+
+
+def test_ilql_sentiments(tmp_path):
+    """gpt2 on (imdb text, label) pairs (reference acceptance config:
+    configs/ilql_config.yml). Gate: mean sentiment metric reaches 0.7, or
+    improves ≥0.1 over the first eval."""
+    from datasets import load_dataset
+
+    import ilql_sentiments
+    import trlx_tpu
+    from trlx_tpu.trainer.api import default_config
+
+    config = default_config("ilql")
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = int(os.environ.get("TRLX_TPU_NETWORK_STEPS", 400))
+
+    imdb = load_dataset("imdb", split="train")
+    trlx_tpu.train(
+        "gpt2",
+        dataset=(imdb["text"], imdb["label"]),
+        eval_prompts=["I don't know much about Hungarian underground"] * 64,
+        metric_fn=ilql_sentiments.build_metric_fn(),
+        config=config,
+    )
+    _assert_learned(
+        _trajectory(str(tmp_path), "metrics/sentiments"), 0.7, 0.1, "ilql_sentiments metric"
+    )
+
+
+def test_ppo_gptj(tmp_path):
+    """GPT-J-6B PPO (the reference's largest shipped recipe,
+    reference: configs/ppo_gptj.yml). Needs a mesh that fits 6B — a v4-32
+    slice per ppo_gptj_config.yml (fsdp=4 × tp=2). Gate: reward improves
+    ≥0.15 over the run's first eval (absolute sentiment 0.8 also passes)."""
+    from datasets import load_dataset
+
+    import ppo_sentiments
+    import trlx_tpu
+    from trlx_tpu.trainer.api import default_config
+
+    config = default_config("ppo_gptj")
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = int(os.environ.get("TRLX_TPU_NETWORK_STEPS", 200))
+
+    imdb = load_dataset("imdb", split="train+test")
+    prompts = [" ".join(review.split()[:4]) for review in imdb["text"]]
+    trlx_tpu.train(
+        "EleutherAI/gpt-j-6B",
+        reward_fn=ppo_sentiments.build_reward_fn(),
+        prompts=prompts,
+        eval_prompts=["I don't know much about Hungarian underground"] * 32,
+        config=config,
+    )
+    _assert_learned(_trajectory(str(tmp_path), "mean_reward"), 0.8, 0.15, "ppo_gptj mean_reward")

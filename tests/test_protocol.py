@@ -1,0 +1,97 @@
+"""BaseRL protocol surface: sample()/act() honor their arguments
+(reference protocol: trlx/model/__init__.py:49-71) and the wandb.watch
+equivalent (`train.watch_interval`) emits per-group grad norms + parameter
+histograms."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+
+
+def _tiny_trainer(tmp_path, **cfg_overrides):
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 8
+    config.method.chunk_size = 8
+    config.method.num_rollouts = 8
+    config.model.num_layers_unfrozen = 1
+    for k, v in cfg_overrides.items():
+        section, key = k.split(".")
+        setattr(getattr(config, section), key, v)
+    return PPOTrainer(config)
+
+
+def test_sample_honors_n_samples_and_length(tmp_path):
+    trainer = _tiny_trainer(tmp_path)
+    P, R = trainer.prompt_length, trainer.response_length
+    rng = np.random.default_rng(0)
+    prompts = {
+        "input_ids": rng.integers(1, 15, size=(4, P)).astype(np.int32),
+        "attention_mask": np.ones((4, P), np.int32),
+    }
+    # n_samples > batch: tiled
+    out = trainer.sample(prompts, length=None, n_samples=6)
+    assert np.asarray(out).shape[0] == 6
+    # n_samples < batch: truncated
+    out = trainer.sample(prompts, length=None, n_samples=2)
+    assert np.asarray(out).shape[0] == 2
+    # length clips the response region (never exceeds compiled R)
+    out = trainer.sample(prompts, length=3, n_samples=4)
+    assert np.asarray(out).shape[1] == P + min(3, R)
+    out = trainer.sample(prompts, length=10 * R, n_samples=4)
+    assert np.asarray(out).shape[1] == P + R
+
+
+def test_act_returns_tokens_and_mask(tmp_path):
+    # act() keeps the orchestrator's contract: batches arrive mesh-divisible
+    # (8 = the conftest virtual-device data axes), unlike sample() which pads.
+    trainer = _tiny_trainer(tmp_path)
+    P = trainer.prompt_length
+    rng = np.random.default_rng(1)
+    data = {
+        "input_ids": rng.integers(1, 15, size=(8, P)).astype(np.int32),
+        "attention_mask": np.ones((8, P), np.int32),
+    }
+    tokens, mask = trainer.act(data)
+    assert np.asarray(tokens).shape == np.asarray(mask).shape
+    assert np.asarray(tokens).shape == (8, P + trainer.response_length)
+
+
+def test_watch_interval_logs_grad_norms_and_histograms(tmp_path):
+    """watch_interval=1: every logged step carries per-group
+    watch/grad_norm/* scalars, and param histograms land in metrics.jsonl."""
+    import trlx_tpu
+
+    walks, logit_mask, metric_fn, reward_fn = generate_random_walks(15, 8, 60, seed=1000)
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 16
+    config.train.total_steps = 3
+    config.train.eval_interval = 100
+    config.train.watch_interval = 1
+    config.model.num_layers_unfrozen = 1
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+
+    grad_groups, hist_names = set(), set()
+    with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            grad_groups.update(k for k in rec if k.startswith("watch/grad_norm/"))
+            if "histogram" in rec and rec["histogram"].startswith("watch/params/"):
+                hist_names.add(rec["histogram"])
+    assert grad_groups, "no per-group grad norms logged"
+    assert hist_names, "no parameter histograms logged"

@@ -12,6 +12,8 @@ from trlx_tpu.models import LMConfig, TransformerLM
 from trlx_tpu.parallel.mesh import make_mesh, set_mesh
 from trlx_tpu.parallel.ring_attention import ring_attention_sharded
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 @pytest.fixture()
 def sp_mesh():

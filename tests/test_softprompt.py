@@ -2,6 +2,8 @@
 generation accounts for the prefix (capability parity with the fork's
 SoftEmbedding, reference: trlx/model/accelerate_ppo_softprompt_model.py:26-81)."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -9,6 +11,8 @@ import numpy as np
 from trlx_tpu.models import LMConfig, LMWithValueHead
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.sampling import GenerateConfig
+
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
 
 
 def build(n_soft=4):

@@ -10,6 +10,8 @@ import trlx_tpu
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.trainer.base import JaxBaseTrainer
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 class CharTokenizer:
     """One token per lowercase letter; ids: pad/eos=1, bos=2, 'a'..'z'=3..28."""

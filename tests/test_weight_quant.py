@@ -18,6 +18,8 @@ from randomwalks import base_config, generate_random_walks  # noqa: E402
 from trlx_tpu.models import TransformerLM  # noqa: E402
 from trlx_tpu.models.lm import LMConfig, quantize_weights  # noqa: E402
 
+pytestmark = pytest.mark.slow  # excluded from `make test-fast` (see conftest)
+
 
 def _tiny_cfg():
     return LMConfig.from_dict(
@@ -157,3 +159,42 @@ def test_w8_refused_without_fused_path(tmp_path):
     config.model.decode_weight_quant = True
     with pytest.raises(ValueError, match="fused"):
         PPOTrainer(config)
+
+
+def test_w8_ref_branch_bias_bounded(tmp_path):
+    """The KL's REF side also feels decode quantization: the fused scorer
+    replays the frozen branch from hiddens produced by the int8 trunk
+    (trainer/ppo.py rollout_score_fused), so ref logprobs carry a small
+    quantization-induced bias vs a full-precision ref forward. Bound it
+    directly: fused (quantized-hidden) vs unfused (full-precision) scoring on
+    IDENTICAL tokens — the per-token ref-logprob delta must stay small."""
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    walks, logit_mask, metric_fn, reward_fn = generate_random_walks(15, 8, 60, seed=1000)
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.batch_size = 16
+    config.model.num_layers_unfrozen = 1  # hydra branch → fused path
+    config.model.decode_weight_quant = True
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    trainer = PPOTrainer(config)
+    assert trainer._qw is not None and trainer.fused_rollout
+
+    B, P = 16, trainer.prompt_length
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, 15, size=(B, P)).astype(np.int32)
+    pmask = np.ones((B, P), np.int32)
+    tokens, mask, stats, prefill = trainer.rollout_generate_fused(prompts, pmask)
+    scores = rng.normal(size=(B,)).astype(np.float32)
+
+    lp_f, _, _, kl_f = trainer.rollout_score_fused(tokens, mask, scores, (stats, prefill))
+    lp_u, _, _, kl_u = trainer.rollout_score(tokens, mask, scores)
+
+    # kl = lp - ref_lp per token (ops/rl_losses.kl_penalty_rewards), so the
+    # ref-side logprobs are recoverable from each scorer's outputs.
+    rlp_fused = np.asarray(lp_f) - np.asarray(kl_f)
+    rlp_full = np.asarray(lp_u) - np.asarray(kl_u)
+    rmask = np.asarray(mask)[:, P:].astype(bool)
+    delta = np.abs(rlp_fused - rlp_full)[rmask]
+    assert delta.max() < 0.05, f"ref-logprob quantization bias too large: {delta.max()}"

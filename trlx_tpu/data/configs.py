@@ -121,6 +121,11 @@ class TrainConfig:
     resume_from_checkpoint: bool = False
     async_checkpointing: bool = True
     profile_dir: Optional[str] = None  # jax.profiler trace output, if set
+    # wandb.watch-equivalent: every N steps log per-group parameter
+    # histograms + per-group grad norms (0 = off). The reference's softprompt
+    # example watches the model (reference:
+    # examples/ppo_softprompt_sentiments.py:38-39).
+    watch_interval: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -323,8 +323,36 @@ class JaxBaseTrainer(BaseRLTrainer):
         ):
             if key in stats_host:
                 parts.append(f"{label}={stats_host[key]:.4g}")
-        print("  ".join(parts) + " " * 8, end="\r", file=sys.stderr, flush=True)
+        # \x1b[K clears to end-of-line so a previous longer line (e.g. one
+        # with eval-only keys) leaves no remnants after the rewrite.
+        print("  ".join(parts) + "\x1b[K", end="\r", file=sys.stderr, flush=True)
         self._progress_open = True
+
+    def log_param_watch(self, limit_per_leaf: int = 4096):
+        """`wandb.watch`-equivalent parameter distributions (the reference's
+        softprompt example watches the model, reference:
+        examples/ppo_softprompt_sentiments.py:38-39), shaped for XLA: per
+        top-level param group, a strided ON-DEVICE subsample (≤limit_per_leaf
+        elements per leaf) is the only host transfer — full params never
+        leave HBM. The grad-side counterpart is the per-group
+        `watch/grad_norm/*` scalars the train step emits when
+        `train.watch_interval` is set.
+
+        Pod runs skip the histograms (slicing non-addressable shards to host
+        is not free of collectives); the grad-norm scalars still flow."""
+        if not self.tracker.enabled or jax.process_count() > 1:
+            return
+        for group, sub in self.state.params.items():
+            pieces = []
+            for leaf in jax.tree_util.tree_leaves(sub):
+                if not hasattr(leaf, "dtype") or not jnp.issubdtype(leaf.dtype, jnp.floating):
+                    continue
+                flat = leaf.reshape(-1)
+                stride = max(1, flat.shape[0] // limit_per_leaf)
+                pieces.append(flat[::stride][:limit_per_leaf].astype(jnp.float32))
+            if pieces:
+                sample = np.asarray(jax.device_get(jnp.concatenate(pieces)))
+                self.tracker.log_histogram(f"watch/params/{group}", sample, step=self.iter_count)
 
     def end_progress(self):
         """Terminate an open \\r-rewritten progress line so subsequent output
@@ -579,6 +607,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
 
+                    # Independent of the log cadence (a nested check would
+                    # silently thin the histograms to lcm(log, watch)).
+                    wi = self.config.train.watch_interval
+                    if wi and self.iter_count % wi == 0:
+                        self.log_param_watch()
+
                     # Mid-batch reaction stays single-process-only: a
                     # per-step agreement collective would tax the hot loop,
                     # and a local-only save would deadlock a pod — pods
@@ -636,20 +670,51 @@ class JaxBaseTrainer(BaseRLTrainer):
         """Export the trained policy trunk as an ordinary HuggingFace
         checkpoint (+ RL heads in trlx_tpu_heads.npz) — the handoff to the
         HF serving/eval ecosystem the reference leaves to manual
-        Accelerate-state unwrapping. Single-host: a pod should first land an
-        orbax checkpoint and export from a one-host restore."""
-        if jax.process_count() > 1:
-            raise RuntimeError(
-                "save_pretrained gathers full params on one host — run it "
-                "single-host from an orbax checkpoint restore"
-            )
+        Accelerate-state unwrapping
+        (reference: trlx/model/accelerate_base_model.py:126-128).
+
+        Pod-safe: on multi-host meshes each param leaf is replicated through
+        a one-leaf jitted identity (every host participates in the SPMD
+        all-gather over ICI/DCN), materialized to host memory, and only
+        rank 0 accumulates the full tree and writes the HF directory — other
+        hosts hold at most one leaf at a time. Returns out_dir on rank 0,
+        None elsewhere; all hosts leave together (barrier)."""
         from trlx_tpu.models.hf_export import export_hf
 
-        params = jax.device_get(self.state.params)
-        heads = {k: v for k, v in params.items() if k != "transformer"}
-        return export_hf(
-            params, self.model.cfg, out_dir, family=family, head_params=heads
-        )
+        if jax.process_count() == 1:
+            params = jax.device_get(self.state.params)
+        else:
+            params = self._gather_params_to_main()
+
+        result = None
+        if params is not None:  # rank 0 (or single host)
+            heads = {k: v for k, v in params.items() if k != "transformer"}
+            result = export_hf(
+                params, self.model.cfg, out_dir, family=family, head_params=heads
+            )
+        barrier()  # non-writing hosts wait for the export to land
+        return result
+
+    def _gather_params_to_main(self):
+        """Replicate each param leaf across the mesh and pull it to host on
+        rank 0. Leaf-at-a-time keeps device overhead to one replicated leaf
+        and non-main host memory O(largest tensor) — the export-side mirror
+        of the streamed safetensors import (models/hf_import.py)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicate = jax.jit(lambda x: x, out_shardings=NamedSharding(self.mesh, PartitionSpec()))
+        main = is_main_process()
+
+        def pull(leaf):
+            rep = replicate(leaf)
+            # A replicated multihost array is NOT fully addressable from one
+            # process — read the local shard (which holds the full value).
+            host = np.asarray(rep.addressable_data(0)) if main else None
+            del rep  # free the replicated device copy before the next leaf
+            return host
+
+        tree = jax.tree_util.tree_map(pull, self.state.params)
+        return tree if main else None
 
     def load(self, directory: Optional[str] = None):
         """Restore a TrainState + host state saved by `save` (resume support
@@ -678,6 +743,26 @@ class JaxBaseTrainer(BaseRLTrainer):
         tokens, mask = self.rollout_generate(data["input_ids"], data["attention_mask"])
         return tokens, mask
 
-    def sample(self, prompts, length: int, n_samples: int):
-        tokens, mask = self.rollout_generate(prompts["input_ids"], prompts["attention_mask"])
+    def sample(self, prompts, length: int = None, n_samples: int = None):
+        """Sample continuations (reference protocol:
+        trlx/model/__init__.py:57-71). `n_samples` rows are produced by tiling
+        or truncating the prompt batch; `length` clips the response region to
+        at most the compiled response length (XLA shapes are static, so longer
+        requests are clipped, not recompiled). The generation batch is padded
+        up to a multiple of the mesh data axes (sharding requirement) and
+        sliced back afterwards."""
+        ids = np.asarray(prompts["input_ids"])
+        mask = np.asarray(prompts["attention_mask"])
+        n = n_samples if n_samples is not None else ids.shape[0]
+        data = int(np.prod([self.mesh.shape[a] for a in DATA_AXES]))
+        gen_rows = -(-n // data) * data
+        reps = -(-gen_rows // ids.shape[0])
+        ids = np.tile(ids, (reps, 1))[:gen_rows]
+        mask = np.tile(mask, (reps, 1))[:gen_rows]
+        tokens, out_mask = self.rollout_generate(ids, mask)
+        tokens = np.asarray(tokens)[:n]
+        if length is not None:
+            P = ids.shape[1]
+            end = P + min(int(length), tokens.shape[1] - P)
+            tokens = tokens[:, :end]
         return tokens

@@ -143,9 +143,11 @@ class ILQLTrainer(JaxBaseTrainer):
         tokens, mask, dstats = self._generate_fn(
             {"params": params}, batch["i"], batch["m"], self.next_rng()
         )
-        import os
-
-        if "debug" not in os.environ:
+        if self.tracker.enabled:
+            # Tracker gating (rank-0, not disabled) replaces the reference's
+            # silent `"debug" in os.environ` switch
+            # (reference: trlx/model/accelerate_base_model.py:72-79) — stat
+            # collection follows the same explicit knob as every other log.
             self._log_decode_stats(dstats, mask)
         return tokens, mask
 
@@ -205,6 +207,9 @@ class ILQLTrainer(JaxBaseTrainer):
             params = optax.apply_updates(state.params, updates)
             stats = dict(stats)
             stats["grad_norm"] = optax.global_norm(grads)
+            if self.config.train.watch_interval:
+                for group, sub in grads.items():
+                    stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
             stats["learning_rate"] = schedule(state.step)
             return state.replace(step=state.step + 1, params=params, opt_state=opt_state), stats
 

@@ -439,6 +439,11 @@ class PPOTrainer(JaxBaseTrainer):
             params = optax.apply_updates(state.params, updates)
             stats = dict(stats)
             stats["grad_norm"] = optax.global_norm(grads)
+            if self.config.train.watch_interval:
+                # per-group grad norms for the wandb.watch-equivalent; device
+                # scalars, fetched only at log boundaries with the rest
+                for group, sub in grads.items():
+                    stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
             stats["learning_rate"] = schedule(state.step)
             new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
             return new_state, stats
