@@ -324,12 +324,45 @@ def main():
             sys.stderr.write(proc.stderr[-1500:])
         return json.loads(proc.stdout.strip().splitlines()[-1])
 
+    # Expected train-phase seconds per iteration at DEFAULT knobs, from
+    # measured history (BASELINE.md). Used only to detect the post-OOM
+    # degraded-device pathology (a 4x-slow train phase was measured once on
+    # the tunneled chip after an OOM'd attempt, r3): a wildly slow phase
+    # triggers ONE fresh-subprocess re-run instead of publishing a poisoned
+    # number.
+    EXPECTED_TRAIN_SECONDS = {"gptj-l8-d4096-2.0B-bf16": 12.7}
+    _knobs_overridden = any(
+        os.environ.get(k)
+        for k in ("BENCH_BATCH", "BENCH_CHUNK", "BENCH_PROMPT", "BENCH_DECODE", "BENCH_REMAT", "BENCH_ITERS")
+    )
+
+    def _train_seconds(result):
+        return (result or {}).get("phase_seconds_per_iter", {}).get("train")
+
+    def _degraded(cand, result):
+        exp = EXPECTED_TRAIN_SECONDS.get(cand[0])
+        t = _train_seconds(result)
+        return bool(exp and t and not _knobs_overridden and t > 2.5 * exp)
+
     def first_fitting(cands, **kwargs):
         for cand in cands:
             result = try_one(cand, **kwargs)
-            if result is not None:
-                return result
-            print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
+            if result is None:
+                print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
+                continue
+            if _degraded(cand, result):
+                print(
+                    f"bench: {cand[0]} train phase {_train_seconds(result):.1f}s vs "
+                    f"~{EXPECTED_TRAIN_SECONDS[cand[0]]}s expected — device may be "
+                    "degraded (post-OOM pathology); re-running once fresh",
+                    file=sys.stderr,
+                )
+                retry = try_one(cand, **kwargs)
+                if retry is not None and (_train_seconds(retry) or 1e9) < _train_seconds(result):
+                    result = retry
+                if _degraded(cand, result):
+                    result["degraded_suspect"] = True  # publish, but flagged
+            return result
         return None
 
     result = first_fitting(candidates)
