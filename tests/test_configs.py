@@ -102,3 +102,37 @@ def test_indivisible_batch_and_chunk_fail_at_construction(tmp_path):
     config.method.chunk_size = 20  # 20 % 8 != 0
     with pytest.raises(ValueError, match="chunk_size"):
         PPOTrainer(config)
+
+
+def test_r4_train_config_fields_round_trip():
+    """watch_interval / compile_cache_dir survive dict round-trips and carry
+    their documented defaults (off)."""
+    from trlx_tpu.data.configs import TRLConfig
+
+    cfg = TRLConfig.from_dict(
+        {
+            "model": {"model_path": "", "tokenizer_path": "", "model_type": "ppo"},
+            "train": {
+                "total_steps": 1, "seq_length": 8, "epochs": 1, "batch_size": 2,
+                "lr_ramp_steps": 1, "lr_decay_steps": 1, "weight_decay": 0.0,
+                "learning_rate_init": 1e-3, "learning_rate_target": 1e-4,
+                "watch_interval": 7, "compile_cache_dir": "/tmp/xla-cache",
+            },
+            "method": {"name": "ppoconfig"},
+        }
+    )
+    assert cfg.train.watch_interval == 7
+    assert cfg.train.compile_cache_dir == "/tmp/xla-cache"
+    default = TRLConfig.from_dict(
+        {
+            "model": {"model_path": "", "tokenizer_path": "", "model_type": "ppo"},
+            "train": {
+                "total_steps": 1, "seq_length": 8, "epochs": 1, "batch_size": 2,
+                "lr_ramp_steps": 1, "lr_decay_steps": 1, "weight_decay": 0.0,
+                "learning_rate_init": 1e-3, "learning_rate_target": 1e-4,
+            },
+            "method": {"name": "ppoconfig"},
+        }
+    )
+    assert default.train.watch_interval == 0
+    assert default.train.compile_cache_dir is None
