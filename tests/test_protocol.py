@@ -95,3 +95,20 @@ def test_watch_interval_logs_grad_norms_and_histograms(tmp_path):
                 hist_names.add(rec["histogram"])
     assert grad_groups, "no per-group grad norms logged"
     assert hist_names, "no parameter histograms logged"
+
+
+def test_compile_cache_dir_populates(tmp_path):
+    """train.compile_cache_dir: trainer construction with the knob set drops
+    compiled programs into the persistent cache (warm restarts skip the
+    cold-start compile measured in the head-to-head)."""
+    cache = tmp_path / "xla_cache"
+    trainer = _tiny_trainer(tmp_path, **{"train.compile_cache_dir": str(cache)})
+    # run one compiled program so at least one entry lands
+    rng = np.random.default_rng(0)
+    P = trainer.prompt_length
+    trainer.sample(
+        {"input_ids": rng.integers(1, 15, size=(8, P)).astype(np.int32),
+         "attention_mask": np.ones((8, P), np.int32)},
+        n_samples=8,
+    )
+    assert cache.exists() and any(cache.iterdir()), "compile cache stayed empty"
