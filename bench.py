@@ -436,20 +436,29 @@ def main():
         try:
             with open(h2h_path) as f:
                 h2h = json.load(f)
-            fields = {
-                "vs_baseline": h2h["vs_baseline_samples_per_s"],
-                "vs_baseline_scope": (
-                    "CPU head-to-head vs the reference's own training loop "
-                    "(randomwalks ILQL, identical dataset/protocol/metric — "
-                    "HEADTOHEAD.json; cold-compile included). Warm-cache: "
-                    f"{h2h.get('vs_baseline_warm_cache')}, full-step steady-state: "
-                    f"{h2h.get('vs_baseline_steady_state')}. Not the v4-32 gate."
-                ),
-                "vs_baseline_final_optimality": {
-                    "reference": h2h["reference"]["final_optimality"],
-                    "ours": h2h["ours"]["final_optimality"],
-                },
-            }
+            if "reference" in h2h:  # legacy single-task layout
+                h2h = {"ilql": h2h}
+            fields = {}
+            if "ilql" in h2h:
+                ilql = h2h["ilql"]
+                fields = {
+                    "vs_baseline": ilql["vs_baseline_samples_per_s"],
+                    "vs_baseline_scope": (
+                        "CPU head-to-head vs the reference's own training loop "
+                        "(randomwalks ILQL, identical dataset/protocol/metric — "
+                        "HEADTOHEAD.json; cold-compile included). Warm-cache: "
+                        f"{ilql.get('vs_baseline_warm_cache')}, full-step steady-state: "
+                        f"{ilql.get('vs_baseline_steady_state')}. Not the v4-32 gate."
+                    ),
+                    "vs_baseline_final_optimality": {
+                        "reference": ilql["reference"]["final_optimality"],
+                        "ours": ilql["ours"]["final_optimality"],
+                    },
+                }
+            if "ppo" in h2h:
+                fields["vs_baseline_ppo"] = h2h["ppo"]["vs_baseline_samples_per_s"]
+                fields["vs_baseline_ppo_warm_cache"] = h2h["ppo"].get("vs_baseline_warm_cache")
+                fields["vs_baseline_ppo_steady_state"] = h2h["ppo"].get("vs_baseline_steady_state")
             result.update(fields)
         except (KeyError, ValueError, TypeError) as e:
             print(f"bench: HEADTOHEAD.json unreadable ({e}); vs_baseline stays null", file=sys.stderr)

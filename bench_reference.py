@@ -1,27 +1,35 @@
 """Head-to-head: the reference's OWN training loop vs trlx_tpu, CPU, identical data.
 
-Ends three rounds of `vs_baseline: null`: runs `/root/reference`'s ILQL
-randomwalks exactly as its example ships it (reference: examples/randomwalks.py:87-109,
-trlx/trlx.py:61-93) through the real Accelerate CPU path, then trlx_tpu's ILQL
-on the IDENTICAL dataset (same walks, same rewards, same graph, seed 1000) with
-the REFERENCE's own optimality metric applied to both sides' eval samples.
+Ends three rounds of `vs_baseline: null`. Two acceptance tasks, BOTH methods:
 
-Scope: CPU smoke (this container exposes one CPU core and one tunneled TPU chip;
-the v4-32 ≥2x gate needs hardware that is not here). Both sides run on the same
-single core: torch eager for the reference, XLA-CPU for trlx_tpu — the same
-"whatever your stack compiles to on this machine" rules the reference's own
-README applies to its GPU numbers. JAX compile time is INCLUDED in trlx_tpu's
-wallclock (reported separately too).
+- ILQL (`--method ilql`): the reference's randomwalks example exactly as it
+  ships (reference: examples/randomwalks.py:87-109, trlx/trlx.py:61-93)
+  through the real Accelerate CPU path, vs trlx_tpu's ILQL on the IDENTICAL
+  dataset (same walks/rewards/graph, seed 1000), judged by the reference's
+  own optimality metric.
+- PPO (`--method ppo`): the reference's flagship method (AcceleratePPOModel +
+  hydra frozen branch, reference: trlx/model/accelerate_ppo_model.py) on a
+  synthetic char task — reward = fraction of 'a' characters in the response —
+  with BOTH sides starting from the IDENTICAL saved init checkpoint and a
+  local char-level tokenizer (no network), matched protocol.
 
-The reference is never edited: import-time stubs for deps absent from this image
-(wandb, deepspeed, torchtyping) and no-op'd Accelerator tracker methods are the
-same shim technique as tests/test_reference_parity.py. Everything the reference
-executes is its shipped code.
+Scope: CPU smoke (this container exposes one CPU core and one tunneled TPU
+chip; the v4-32 ≥2x gate needs hardware that is not here). Both sides run on
+the same single core: torch eager for the reference, XLA-CPU for trlx_tpu.
+JAX compile time is INCLUDED in trlx_tpu's cold wallclock (warm-cache pass
+reported separately).
+
+The reference is never edited: import-time stubs for deps absent from this
+image (wandb, deepspeed, torchtyping), no-op'd Accelerator tracker methods,
+and a `use_cache=False` patch on ModelBranch.forward (transformers>=4.38
+removed tuple `presents` from GPT2Block outputs; cache collection has no
+effect on logits) — the same shim technique as tests/test_reference_parity.py.
+Everything the reference executes is its shipped code.
 
 Usage:
-  python bench_reference.py            # run both sides, write HEADTOHEAD.json
-  python bench_reference.py --side ref # (internal) reference side only
-  python bench_reference.py --side ours# (internal) trlx_tpu side only
+  python bench_reference.py                 # both methods -> HEADTOHEAD.json
+  python bench_reference.py --method ilql   # one method only
+  python bench_reference.py --side ref ...  # (internal) one side subprocess
 
 bench.py picks up HEADTOHEAD.json to fill `vs_baseline` in the bench JSON.
 """
@@ -38,7 +46,90 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 REFERENCE_ROOT = "/root/reference"
 RESULT_PATH = os.path.join(REPO, "HEADTOHEAD.json")
 
-THRESHOLDS = [0.5, 0.7, 0.8, 0.9]
+THRESHOLDS = {"ilql": [0.5, 0.7, 0.8, 0.9], "ppo": [0.05, 0.1, 0.2, 0.3]}
+TRAJECTORY_KEY = {"ilql": "optimality", "ppo": "reward"}
+
+# PPO char task: both sides start from the IDENTICAL saved init checkpoint.
+# d144/L4 keeps per-step work comparable to the ILQL task's (d144 reference
+# example model) — large enough that neither stack is dominated by per-call
+# dispatch overhead on this single core.
+PPO_CHARS = "abcdefghijklmnopqrstuvwxyz0123456789 .,!?"
+PPO_PROTOCOL = dict(
+    n_layer=4, d_model=144, n_head=4, vocab=42, seq_length=32,
+    batch_size=64, total_steps=300, num_rollouts=128, chunk_size=64,
+    ppo_epochs=4, lr_init=1e-3, lr_target=1e-4, init_kl_coef=0.05,
+    eval_interval=25, num_layers_unfrozen=2, response_tokens=24,
+)
+
+
+def _ppo_reward_fn(texts):
+    r = PPO_PROTOCOL["response_tokens"]
+    return [sum(c == "a" for c in t) / float(r) for t in texts]
+
+
+def _ppo_prompts():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return ["".join(rng.choice(list("bcdefgh"), size=6)) for _ in range(64)]
+
+
+def _parse_ours_metrics(ckpt_dir, key, t0):
+    """Shared trlx_tpu-side accounting from the tracker's metrics.jsonl:
+    (trajectory of `key`, eval seconds, per-step times). Eval cost counts
+    generate + reward + metric time — the same components the reference
+    side's timed evaluate() wrapper excludes from train_s."""
+    trajectory, eval_s, step_times = [], 0.0, []
+    with open(os.path.join(ckpt_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if key in rec:
+                trajectory.append({"t": round(rec["t"] - t0, 2), "value": round(rec[key], 4)})
+            eval_s += (
+                rec.get("generate_time", 0.0)
+                + rec.get("reward_time", 0.0)
+                + rec.get("metric_time", 0.0)
+            )
+            if "step_time" in rec:
+                step_times.append(rec["step_time"])
+    return trajectory, eval_s, step_times
+
+
+def build_ppo_assets(assets_dir):
+    """Identical starting point for both sides: a tiny GPT-2 checkpoint
+    (fixed torch seed) + a char-level byte-BPE tokenizer, saved as ordinary
+    HF files. The reference loads them with from_pretrained; trlx_tpu streams
+    the same safetensors through models/hf_import — so the two frameworks
+    train the SAME initial weights."""
+    import json as _json
+
+    import torch
+    import transformers
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    p = PPO_PROTOCOL
+    if os.path.exists(os.path.join(assets_dir, "model.safetensors")):
+        return assets_dir
+    os.makedirs(assets_dir, exist_ok=True)
+    cfg = transformers.GPT2Config(
+        n_layer=p["n_layer"], n_embd=p["d_model"], n_head=p["n_head"],
+        vocab_size=p["vocab"], n_positions=128,
+        bos_token_id=p["vocab"] - 1, eos_token_id=p["vocab"] - 1,
+    )
+    torch.manual_seed(7)
+    transformers.GPT2LMHeadModel(cfg).save_pretrained(assets_dir, safe_serialization=True)
+    b2u = bytes_to_unicode()
+    vocab = {}
+    for ch in PPO_CHARS:
+        rep = "".join(b2u[b] for b in ch.encode("utf-8"))
+        vocab.setdefault(rep, len(vocab))
+    vocab["<|endoftext|>"] = len(vocab)
+    assert len(vocab) == p["vocab"], len(vocab)
+    with open(os.path.join(assets_dir, "vocab.json"), "w") as f:
+        _json.dump(vocab, f)
+    with open(os.path.join(assets_dir, "merges.txt"), "w") as f:
+        f.write("#version: 0.2\n")
+    return assets_dir
 
 
 # ---------------------------------------------------------------------------
@@ -76,6 +167,68 @@ def _install_reference_stubs():
             return cls
 
     sys.modules["torchtyping"].TensorType = _TensorType
+
+
+def _instrument_reference():
+    """Harness-side shims (the reference itself is untouched): no-op'd
+    Accelerator trackers with a log recorder, AdamW step timestamps
+    (full-step steady-state = median inter-step delta, robust to eval-step
+    outliers and the same definition as trlx_tpu's per-step step_time), and
+    a timed evaluate() wrapper so eval cost is excluded from train_s.
+    Returns (logged, eval_seconds, step_stamps). Call after
+    _install_reference_stubs()."""
+    import torch
+    from accelerate import Accelerator
+
+    from trlx.model.accelerate_base_model import AccelerateRLModel
+
+    logged = []
+    Accelerator.init_trackers = lambda self, *a, **k: None
+    Accelerator.log = lambda self, stats, **k: logged.append((time.time(), dict(stats)))
+
+    step_stamps = []
+    orig_opt_step = torch.optim.AdamW.step
+
+    def timed_opt_step(self, *a, **k):
+        r = orig_opt_step(self, *a, **k)
+        step_stamps.append(time.time())
+        return r
+
+    torch.optim.AdamW.step = timed_opt_step
+
+    eval_seconds = [0.0]
+    orig_evaluate = AccelerateRLModel.evaluate
+
+    def timed_evaluate(self):
+        t = time.time()
+        out = orig_evaluate(self)
+        eval_seconds[0] += time.time() - t
+        return out
+
+    AccelerateRLModel.evaluate = timed_evaluate
+    return logged, eval_seconds, step_stamps
+
+
+def _side_result(impl, steps, batch, wall, eval_s, trajectory, final_key, step_seconds):
+    """Shared result assembly — both sides, both methods, measured under the
+    same rules (train_s = wall − eval cost; steady-state = batch / median
+    full-step seconds)."""
+    import numpy as np
+
+    train_s = wall - eval_s
+    steady = batch / float(np.median(step_seconds)) if len(step_seconds) else None
+    return {
+        "impl": impl,
+        "steps": int(steps),
+        "batch_size": int(batch),
+        "wallclock_s": round(wall, 2),
+        "eval_s": round(eval_s, 2),
+        "train_s": round(train_s, 2),
+        "samples_per_s": round(steps * batch / train_s, 2),
+        "steady_state_samples_per_s": round(steady, 1) if steady else None,
+        final_key: (trajectory[-1]["value"] if trajectory else None),
+        "trajectory": trajectory,
+    }
 
 
 def run_reference_side(dataset_path: str, workdir: str) -> dict:
@@ -116,40 +269,7 @@ def run_reference_side(dataset_path: str, workdir: str) -> dict:
         worstlen=worstlen,
     )
 
-    # --- shim layer (harness-side; the reference itself is untouched) -----
-    from accelerate import Accelerator
-
-    logged = []
-    t0 = time.time()
-    Accelerator.init_trackers = lambda self, *a, **k: None
-    Accelerator.log = lambda self, stats, **k: logged.append((time.time(), dict(stats)))
-
-    # Full-step steady-state: timestamp every optimizer step; the median
-    # inter-step delta is robust to the eval-step outliers (50 of 800) and
-    # includes loss+backward+opt+scheduler+tqdm — the same definition as the
-    # trlx_tpu side's per-step step_time.
-    step_stamps = []
-    orig_opt_step = torch.optim.AdamW.step
-
-    def timed_opt_step(self, *a, **k):
-        r = orig_opt_step(self, *a, **k)
-        step_stamps.append(time.time())
-        return r
-
-    torch.optim.AdamW.step = timed_opt_step
-
-    from trlx.model.accelerate_base_model import AccelerateRLModel
-
-    eval_seconds = [0.0]
-    orig_evaluate = AccelerateRLModel.evaluate
-
-    def timed_evaluate(self):
-        t = time.time()
-        out = orig_evaluate(self)
-        eval_seconds[0] += time.time() - t
-        return out
-
-    AccelerateRLModel.evaluate = timed_evaluate
+    logged, eval_seconds, step_stamps = _instrument_reference()
 
     # --- the reference example's own __main__, verbatim semantics ---------
     import trlx
@@ -176,29 +296,16 @@ def run_reference_side(dataset_path: str, workdir: str) -> dict:
     )
     wall = time.time() - t0
 
-    steps = model.iter_count
-    batch = config.train.batch_size
     trajectory = [
-        {"t": round(t - t0, 2), "optimality": float(torch.as_tensor(s["metrics/optimality"]).mean())}
+        {"t": round(t - t0, 2), "value": round(float(torch.as_tensor(s["metrics/optimality"]).mean()), 4)}
         for (t, s) in logged
         if "metrics/optimality" in s
     ]
-    final_opt = trajectory[-1]["optimality"] if trajectory else float("nan")
-    train_s = wall - eval_seconds[0]
-    deltas = np.diff(step_stamps)
-    steady = batch / float(np.median(deltas)) if len(deltas) else None
-    return {
-        "impl": "reference (trlx v0.2.0, torch eager, Accelerate CPU)",
-        "steps": int(steps),
-        "batch_size": int(batch),
-        "wallclock_s": round(wall, 2),
-        "eval_s": round(eval_seconds[0], 2),
-        "train_s": round(train_s, 2),
-        "samples_per_s": round(steps * batch / train_s, 2),
-        "steady_state_samples_per_s": round(steady, 1) if steady else None,
-        "final_optimality": round(final_opt, 4),
-        "trajectory": trajectory,
-    }
+    return _side_result(
+        "reference (trlx v0.2.0, torch eager, Accelerate CPU)",
+        model.iter_count, config.train.batch_size, wall, eval_seconds[0],
+        trajectory, "final_optimality", np.diff(step_stamps),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -282,34 +389,190 @@ def run_ours_side(dataset_path: str, workdir: str) -> dict:
     )
     wall = time.time() - t0
 
-    # Trajectory + eval cost + per-step times from the tracker's JSONL.
-    trajectory, eval_s, step_times = [], 0.0, []
-    with open(os.path.join(config.train.checkpoint_dir, "metrics.jsonl")) as f:
-        for line in f:
-            rec = json.loads(line)
-            if "metrics/optimality" in rec:
-                trajectory.append({"t": round(rec["t"] - t0, 2), "optimality": rec["metrics/optimality"]})
-            eval_s += rec.get("generate_time", 0.0) + rec.get("metric_time", 0.0)
-            if "step_time" in rec:
-                step_times.append(rec["step_time"])
-    final_opt = trajectory[-1]["optimality"] if trajectory else float("nan")
-    steps = model.iter_count
-    batch = config.train.batch_size
-    train_s = wall - eval_s
-    # steady-state excludes one-time XLA compilation (in-train_s otherwise)
-    steady = batch / float(np.median(step_times)) if step_times else None
-    return {
-        "impl": "trlx_tpu (JAX/XLA CPU, jit train step)",
-        "steps": int(steps),
-        "batch_size": int(batch),
-        "wallclock_s": round(wall, 2),
-        "eval_s": round(eval_s, 2),
-        "train_s": round(train_s, 2),
-        "samples_per_s": round(steps * batch / train_s, 2),
-        "steady_state_samples_per_s": round(steady, 1) if steady else None,
-        "final_optimality": round(float(final_opt), 4),
-        "trajectory": trajectory,
+    trajectory, eval_s, step_times = _parse_ours_metrics(
+        config.train.checkpoint_dir, "metrics/optimality", t0
+    )
+    return _side_result(
+        "trlx_tpu (JAX/XLA CPU, jit train step)",
+        model.iter_count, config.train.batch_size, wall, eval_s,
+        trajectory, "final_optimality", step_times,
+    )
+
+
+# ---------------------------------------------------------------------------
+# PPO sides
+
+
+def run_reference_side_ppo(assets_dir: str, workdir: str) -> dict:
+    """The reference's flagship PPO (hydra frozen branch, adaptive KL,
+    alternating rollout/optimize) through its real trlx.train, on the char
+    task, from the shared init checkpoint."""
+    _install_reference_stubs()
+    sys.path.insert(0, REFERENCE_ROOT)
+
+    import torch
+
+    build_ppo_assets(assets_dir)
+    logged, eval_seconds, step_stamps = _instrument_reference()
+
+    from trlx.model.nn.ppo_models import ModelBranch
+
+    orig_mb = ModelBranch.forward
+
+    def mb_no_cache(self, *a, **k):
+        # transformers>=4.38 removed tuple `presents` from GPT2Block outputs
+        # (the reference indexes outputs[1] when use_cache). Cache collection
+        # has no effect on the frozen branch's logits — force it off.
+        k["use_cache"] = False
+        return orig_mb(self, *a, **k)
+
+    ModelBranch.forward = mb_no_cache
+
+    import numpy as np
+    import trlx
+    from trlx.data.configs import TRLConfig
+
+    p = PPO_PROTOCOL
+    prompts = _ppo_prompts()
+    config = TRLConfig.load_yaml(os.path.join(REFERENCE_ROOT, "configs", "ppo_config.yml"))
+    config.model.model_path = assets_dir
+    config.model.tokenizer_path = assets_dir
+    config.model.num_layers_unfrozen = p["num_layers_unfrozen"]
+    config.train.seq_length = p["seq_length"]
+    config.train.batch_size = p["batch_size"]
+    config.train.total_steps = p["total_steps"]
+    config.train.epochs = 10**6
+    config.train.eval_interval = p["eval_interval"]
+    config.train.checkpoint_interval = 10**9
+    config.train.checkpoint_dir = os.path.join(workdir, "ref_ckpts")
+    config.train.learning_rate_init = p["lr_init"]
+    config.train.learning_rate_target = p["lr_target"]
+    config.method.init_kl_coef = p["init_kl_coef"]
+    config.method.num_rollouts = p["num_rollouts"]
+    config.method.chunk_size = p["chunk_size"]
+    # Prompts tokenize to exactly 6 char-tokens and HF max_length counts
+    # prompt+response, so 6+24 pins the response at response_tokens — the
+    # same 24 tokens the trlx_tpu side decodes (matched protocol, matched
+    # reward denominator).
+    ref_total_len = 6 + p["response_tokens"]
+    config.method.gen_kwargs = {
+        "max_length": ref_total_len,
+        "min_length": ref_total_len,
+        "top_k": 0.0,
+        "top_p": 1.0,
+        "do_sample": True,
     }
+
+    os.chdir(workdir)
+    t0 = time.time()
+    model = trlx.train(
+        reward_fn=_ppo_reward_fn,
+        prompts=prompts,
+        eval_prompts=prompts[: p["batch_size"] // 2],
+        config=config,
+    )
+    wall = time.time() - t0
+
+    trajectory = [
+        {"t": round(t - t0, 2), "value": round(float(torch.as_tensor(s["mean_reward"])), 4)}
+        for (t, s) in logged
+        if "mean_reward" in s
+    ]
+    return _side_result(
+        "reference (trlx v0.2.0, torch eager, Accelerate CPU, hydra PPO)",
+        model.iter_count, p["batch_size"], wall, eval_seconds[0],
+        trajectory, "final_reward", np.diff(step_stamps),
+    )
+
+
+def run_ours_side_ppo(assets_dir: str, workdir: str) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    p = PPO_PROTOCOL
+    prompts = _ppo_prompts()
+    ckpt_dir = os.path.join(workdir, "ours_ckpts")
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_path": assets_dir,
+                "tokenizer_path": assets_dir,
+                "model_type": "ppo",
+                "num_layers_unfrozen": p["num_layers_unfrozen"],
+                "dtype": "float32",
+                "param_dtype": "float32",
+            },
+            "train": {
+                "seq_length": p["seq_length"],
+                "epochs": 10**6,
+                "total_steps": p["total_steps"],
+                "batch_size": p["batch_size"],
+                "lr_ramp_steps": 10,
+                "lr_decay_steps": p["total_steps"],
+                "weight_decay": 1.0e-6,
+                "learning_rate_init": p["lr_init"],
+                "learning_rate_target": p["lr_target"],
+                "opt_betas": [0.9, 0.95],
+                "checkpoint_interval": 10**9,
+                "eval_interval": p["eval_interval"],
+                "orchestrator": "PPOOrchestrator",
+                "mesh": [-1, 1, 1, 1],
+                "seed": 1000,
+                "checkpoint_dir": ckpt_dir,
+            },
+            "method": {
+                "name": "ppoconfig",
+                "num_rollouts": p["num_rollouts"],
+                "chunk_size": p["chunk_size"],
+                "ppo_epochs": p["ppo_epochs"],
+                "init_kl_coef": p["init_kl_coef"],
+                "target": 6,
+                "horizon": 10000,
+                "gamma": 1.0,
+                "lam": 0.95,
+                "cliprange": 0.2,
+                "cliprange_value": 0.2,
+                "vf_coef": 1.0,
+                "gen_kwargs": {
+                    "prompt_length": p["seq_length"] - p["response_tokens"],
+                    "max_new_tokens": p["response_tokens"],
+                    "min_new_tokens": p["response_tokens"],
+                    "top_k": 0,
+                    "top_p": 1.0,
+                    "do_sample": True,
+                    "temperature": 1.0,
+                },
+            },
+        }
+    )
+
+    t0 = time.time()
+    model = trlx_tpu.train(
+        reward_fn=_ppo_reward_fn,
+        prompts=prompts,
+        eval_prompts=prompts[: p["batch_size"] // 2],
+        config=config,
+    )
+    wall = time.time() - t0
+
+    trajectory, eval_s, step_times = _parse_ours_metrics(ckpt_dir, "mean_reward", t0)
+    return _side_result(
+        "trlx_tpu (JAX/XLA CPU, jit train step, hydra PPO)",
+        model.iter_count, p["batch_size"], wall, eval_s,
+        trajectory, "final_reward", step_times,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -318,28 +581,44 @@ def run_ours_side(dataset_path: str, workdir: str) -> dict:
 
 def time_to(trajectory, thr):
     for p in trajectory:
-        if p["optimality"] >= thr:
+        if p["value"] >= thr:
             return p["t"]
     return None
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--side", choices=["ref", "ours"])
-    parser.add_argument("--dataset", default=None)
-    parser.add_argument("--workdir", default=None)
-    parser.add_argument("--out", default=None)
-    args = parser.parse_args()
+_SIDE_FNS = {
+    ("ref", "ilql"): run_reference_side,
+    ("ours", "ilql"): run_ours_side,
+    ("ref", "ppo"): run_reference_side_ppo,
+    ("ours", "ppo"): run_ours_side_ppo,
+}
 
-    if args.side:
-        fn = run_reference_side if args.side == "ref" else run_ours_side
-        result = fn(args.dataset, args.workdir)
-        with open(args.out, "w") as f:
-            json.dump(result, f)
-        return
+_TASK_META = {
+    "ilql": {
+        "task": "randomwalks ILQL (reference: examples/randomwalks.py, seed 1000)",
+        "final_key": "final_optimality",
+    },
+    "ppo": {
+        "task": "char-task PPO, reward = frac('a') in response (hydra frozen branch, "
+                "identical init checkpoint both sides)",
+        "final_key": "final_reward",
+    },
+}
 
-    workdir = tempfile.mkdtemp(prefix="headtohead_")
-    dataset = os.path.join(workdir, "dataset.npz")
+_SCOPE = (
+    "cpu-smoke: both sides on this container's single CPU core, identical "
+    "dataset/init, matched protocol (batch/steps/LR/method constants), and the "
+    "same metric applied to both; NOT the v4-32 gate"
+)
+
+
+def run_method(method: str) -> dict:
+    workdir = tempfile.mkdtemp(prefix=f"headtohead_{method}_")
+    # For ILQL the shared artifact is the dataset the reference side
+    # generates; for PPO it is the init checkpoint + tokenizer dir.
+    shared = os.path.join(workdir, "dataset.npz" if method == "ilql" else "assets")
+    key = TRAJECTORY_KEY[method]
+    final_key = _TASK_META[method]["final_key"]
     sides = {}
     for side, label in (("ref", "ref"), ("ours", "ours"), ("ours", "ours_warm")):
         out = os.path.join(workdir, f"{label}.json")
@@ -350,22 +629,22 @@ def main():
             env["TRLX_TPU_NO_PROGRESS"] = "1"
             env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(workdir, "xla_cache")
         os.makedirs(os.path.join(workdir, label), exist_ok=True)
-        print(f"[bench_reference] running {label} side ...", flush=True)
+        print(f"[bench_reference] running {method}/{label} ...", flush=True)
         t = time.time()
         subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--side", side,
-             "--dataset", dataset, "--workdir", os.path.join(workdir, label), "--out", out],
+            [sys.executable, os.path.abspath(__file__), "--side", side, "--method", method,
+             "--dataset", shared, "--workdir", os.path.join(workdir, label), "--out", out],
             env=env, check=True, cwd=REPO,
         )
         with open(out) as f:
             sides[label] = json.load(f)
-        print(f"[bench_reference] {label} done in {time.time()-t:.1f}s: "
+        print(f"[bench_reference] {method}/{label} done in {time.time()-t:.1f}s: "
               f"{sides[label]['samples_per_s']} samples/s, "
-              f"final optimality {sides[label]['final_optimality']}", flush=True)
+              f"final {key} {sides[label][final_key]}", flush=True)
 
     ref, ours, warm = sides["ref"], sides["ours"], sides["ours_warm"]
     t2o = {}
-    for thr in THRESHOLDS:
+    for thr in THRESHOLDS[method]:
         tr, to = time_to(ref["trajectory"], thr), time_to(ours["trajectory"], thr)
         tw = time_to(warm["trajectory"], thr)
         t2o[str(thr)] = {
@@ -374,11 +653,9 @@ def main():
             "ours_warm_s": tw,
             "speedup": round(tr / to, 2) if (tr and to) else None,
         }
-    result = {
-        "task": "randomwalks ILQL (reference: examples/randomwalks.py, seed 1000)",
-        "scope": ("cpu-smoke: both sides on this container's single CPU core, identical "
-                  "dataset, matched protocol (batch/steps/LR/method constants), and the "
-                  "reference's own optimality metric; NOT the v4-32 gate"),
+    return {
+        "task": _TASK_META[method]["task"],
+        "scope": _SCOPE,
         "reference": ref,
         "ours": ours,
         "ours_warm_cache": warm,
@@ -389,18 +666,51 @@ def main():
             if ours.get("steady_state_samples_per_s") and ref.get("steady_state_samples_per_s")
             else None
         ),
-        "time_to_optimality": t2o,
-        "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        f"time_to_{key}": t2o,
     }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--side", choices=["ref", "ours"])
+    parser.add_argument("--method", choices=["ilql", "ppo", "both"], default="both")
+    parser.add_argument("--dataset", default=None)
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    if args.side:
+        if args.method == "both":
+            parser.error("--side requires an explicit --method (ilql or ppo)")
+        result = _SIDE_FNS[(args.side, args.method)](args.dataset, args.workdir)
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+        return
+
+    # Merge into the existing HEADTOHEAD.json so the two methods can be
+    # (re)run independently; migrate the legacy single-task layout.
+    existing = {}
+    if os.path.exists(RESULT_PATH):
+        with open(RESULT_PATH) as f:
+            existing = json.load(f)
+        if "reference" in existing:
+            existing = {"ilql": existing}
+
+    methods = ["ilql", "ppo"] if args.method == "both" else [args.method]
+    for method in methods:
+        existing[method] = run_method(method)
+    existing["recorded_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
     with open(RESULT_PATH, "w") as f:
-        json.dump(result, f, indent=1)
-    print(json.dumps({
-        "metric": "headtohead_cpu_ilql_randomwalks_speedup",
-        "value": result["vs_baseline_samples_per_s"],
-        "unit": "x reference samples/s (CPU)",
-        "ref_final_optimality": ref["final_optimality"],
-        "ours_final_optimality": ours["final_optimality"],
-    }))
+        json.dump(existing, f, indent=1)
+
+    summary = {"metric": "headtohead_cpu_speedup_vs_reference", "unit": "x reference samples/s (CPU)"}
+    for method in ("ilql", "ppo"):
+        if method in existing:
+            r = existing[method]
+            summary[f"{method}_cold"] = r["vs_baseline_samples_per_s"]
+            summary[f"{method}_warm_cache"] = r["vs_baseline_warm_cache"]
+            summary[f"{method}_steady_state"] = r["vs_baseline_steady_state"]
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -55,7 +55,10 @@ class JaxBaseTrainer(BaseRLTrainer):
             # weren't cached.
             os.makedirs(config.train.compile_cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", config.train.compile_cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            # 0.0, not a threshold: production programs all compile >1s, and
+            # a threshold would silently skip caching small test/dev models
+            # (making the knob look broken exactly where users first try it).
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
         init_distributed()
         self.mesh = make_mesh(config.train.mesh, devices=kwargs.pop("mesh_devices", None))
