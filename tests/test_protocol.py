@@ -112,3 +112,60 @@ def test_compile_cache_dir_populates(tmp_path):
         n_samples=8,
     )
     assert cache.exists() and any(cache.iterdir()), "compile cache stayed empty"
+
+
+def test_ppo_headtohead_assets_round_trip(tmp_path):
+    """Guards bench_reference.py's PPO harness against bitrot: the shared
+    init checkpoint + char tokenizer build offline, the tokenizer encodes/
+    decodes the task alphabet, and trlx_tpu's streamed importer loads the
+    checkpoint into a working trainer."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench_reference import PPO_PROTOCOL, build_ppo_assets, _ppo_prompts, _ppo_reward_fn
+
+    assets = str(tmp_path / "assets")
+    build_ppo_assets(assets)
+
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(assets, use_fast=False)
+    text = "abc 123!"
+    ids = tok(text).input_ids
+    assert tok.decode(ids) == text
+    assert len(ids) == len(text)  # strictly char-level: no merges
+
+    prompts = _ppo_prompts()
+    assert len(prompts) == 64 and all(len(p) == 6 for p in prompts)
+    assert _ppo_reward_fn(["a" * 24, "b" * 24]) == [1.0, 0.0]
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    p = PPO_PROTOCOL
+    config = TRLConfig.from_dict(
+        {
+            "model": {"model_path": assets, "tokenizer_path": assets, "model_type": "ppo",
+                      "num_layers_unfrozen": p["num_layers_unfrozen"], "dtype": "float32",
+                      "param_dtype": "float32"},
+            "train": {"seq_length": p["seq_length"], "epochs": 1, "total_steps": 1,
+                      "batch_size": 8, "lr_ramp_steps": 1, "lr_decay_steps": 10,
+                      "weight_decay": 0.0, "learning_rate_init": 1e-3,
+                      "learning_rate_target": 1e-4, "checkpoint_dir": str(tmp_path / "ck"),
+                      "mesh": [-1, 1, 1, 1], "seed": 0},
+            "method": {"name": "ppoconfig", "num_rollouts": 8, "chunk_size": 8,
+                       "gen_kwargs": {"prompt_length": 8, "max_new_tokens": 4, "do_sample": True}},
+        }
+    )
+    trainer = PPOTrainer(config)
+    assert trainer.model.branch_layer >= 0  # hydra engaged, as in the h2h
+    enc = tok(prompts[:8], padding=False)
+    import numpy as _np
+
+    ids8 = _np.full((8, 8), tok.eos_token_id, dtype=_np.int32)
+    mask8 = _np.zeros((8, 8), dtype=_np.int32)
+    for i, row in enumerate(enc.input_ids):
+        ids8[i, -len(row):] = row
+        mask8[i, -len(row):] = 1
+    tokens, _ = trainer.rollout_generate(ids8, mask8)
+    assert _np.asarray(tokens).shape == (8, 12)
