@@ -398,10 +398,36 @@ def main():
             return result
         return None
 
+    bench_t0 = time.time()
+    # Parse up front: a malformed value must fail BEFORE the flagship run,
+    # not crash the bench after it (losing the very JSON line this guard
+    # protects).
+    try:
+        optional_deadline = float(os.environ.get("BENCH_OPTIONAL_DEADLINE", "900"))
+    except ValueError:
+        print("bench: invalid BENCH_OPTIONAL_DEADLINE; using 900s", file=sys.stderr)
+        optional_deadline = 900.0
+
+    def _optional_budget_left(label):
+        """The flagship number must never be lost to a driver-side timeout
+        because optional points pushed the total past the budget: once
+        elapsed exceeds BENCH_OPTIONAL_DEADLINE seconds (e.g. the flagship
+        needed slow OOM fallbacks), skip remaining optional points with a
+        note instead of gambling the whole JSON line."""
+        deadline = optional_deadline
+        if time.time() - bench_t0 > deadline:
+            print(
+                f"bench: skipping {label} — {time.time() - bench_t0:.0f}s elapsed "
+                f"exceeds BENCH_OPTIONAL_DEADLINE={deadline:.0f}s",
+                file=sys.stderr,
+            )
+            return False
+        return True
+
     result = first_fitting(candidates)
     if result is None:
         raise RuntimeError("no bench size fit the device")
-    if fp32_candidates and fp32_point:
+    if fp32_candidates and fp32_point and _optional_budget_left("fp32 point"):
         gc.collect()
         fp32 = first_fitting(fp32_candidates, iters=2, orchestrator=False)
         if fp32 is not None:
@@ -421,7 +447,7 @@ def main():
     # ILQL measured point (the reference ships two methods; both get a perf
     # story). Heads add ~4x(2d*V) params over the PPO config, so the fitting
     # size may be smaller — the same OOM-fallback machinery sizes it.
-    if os.environ.get("BENCH_ILQL_POINT", "1") == "1":
+    if os.environ.get("BENCH_ILQL_POINT", "1") == "1" and _optional_budget_left("ILQL point"):
         gc.collect()
         ilql_candidates = ILQL_SIZES if preset == "auto" else [ILQL_SIZES[-1]]
         if jax.default_backend() != "tpu":
