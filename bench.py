@@ -192,12 +192,13 @@ _OOM_PHRASES = (
 
 
 def is_transient_compile_failure(e: Exception) -> bool:
-    """The tunneled backend's remote compile service can 500 transiently
-    (tpu_compile_helper subprocess failures). Those deserve ONE same-size
-    retry — falling straight back to a smaller size would silently shrink
-    the flagship measurement."""
+    """The tunneled backend can fail transiently: remote-compile HTTP 500s
+    (tpu_compile_helper subprocess failures) and FAILED_PRECONDITION device
+    states right after a previous process released the chip. Those deserve
+    ONE same-size retry — falling straight back to a smaller size would
+    silently shrink the flagship measurement."""
     msg = str(e).lower()
-    return "remote_compile" in msg and "http 5" in msg
+    return ("remote_compile" in msg and "http 5" in msg) or "failed_precondition" in msg
 
 
 def is_oom(e: Exception) -> bool:
@@ -288,13 +289,19 @@ def main():
         jax.default_backend() == "tpu" and os.environ.get("BENCH_SUBPROC", "1") == "1"
     )
 
-    def try_one(cand, **kwargs):
+    def try_one(cand, _retried=False, **kwargs):
         nonlocal use_subproc
         if not use_subproc:
             try:
                 return run_one(cand, **kwargs)
             except Exception as e:
                 if not is_oom(e):
+                    if is_transient_compile_failure(e) and not _retried:
+                        # same-size retry exists on this path too — without
+                        # it a transient FAILED_PRECONDITION on the flagship
+                        # would abort the whole bench in in-process mode.
+                        print("bench: transient backend failure; retrying this size once", file=sys.stderr)
+                        return try_one(cand, _retried=True, **kwargs)
                     raise
                 # Drop the traceback BEFORE collecting: its frames pin the
                 # failed trainer's device arrays.
@@ -414,11 +421,11 @@ def main():
         elapsed exceeds BENCH_OPTIONAL_DEADLINE seconds (e.g. the flagship
         needed slow OOM fallbacks), skip remaining optional points with a
         note instead of gambling the whole JSON line."""
-        deadline = optional_deadline
-        if time.time() - bench_t0 > deadline:
+        elapsed = time.time() - bench_t0
+        if elapsed > optional_deadline:
             print(
-                f"bench: skipping {label} — {time.time() - bench_t0:.0f}s elapsed "
-                f"exceeds BENCH_OPTIONAL_DEADLINE={deadline:.0f}s",
+                f"bench: skipping {label} — {elapsed:.0f}s elapsed exceeds "
+                f"BENCH_OPTIONAL_DEADLINE={optional_deadline:.0f}s",
                 file=sys.stderr,
             )
             return False
@@ -427,9 +434,23 @@ def main():
     result = first_fitting(candidates)
     if result is None:
         raise RuntimeError("no bench size fit the device")
+    def _optional_point(label, fn):
+        """Optional points are failure-isolated: ANY error in one (transient
+        backend states, subprocess deaths) must cost that point only — never
+        the flagship JSON line measured above. Observed live: a
+        FAILED_PRECONDITION in the fp32 subprocess after the flagship
+        completed would have discarded the whole run."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — the whole point is isolation
+            print(f"bench: {label} failed ({type(e).__name__}: {str(e)[:300]}); continuing without it", file=sys.stderr)
+            return None
+
     if fp32_candidates and fp32_point and _optional_budget_left("fp32 point"):
         gc.collect()
-        fp32 = first_fitting(fp32_candidates, iters=2, orchestrator=False)
+        fp32 = _optional_point(
+            "fp32 point", lambda: first_fitting(fp32_candidates, iters=2, orchestrator=False)
+        )
         if fp32 is not None:
             result["fp32_master_point"] = {
                 k: fp32[k]
@@ -452,7 +473,9 @@ def main():
         ilql_candidates = ILQL_SIZES if preset == "auto" else [ILQL_SIZES[-1]]
         if jax.default_backend() != "tpu":
             ilql_candidates = [ILQL_SIZES[-1]]
-        ilql = first_fitting(ilql_candidates, mode="ilql", iters=2)
+        ilql = _optional_point(
+            "ILQL point", lambda: first_fitting(ilql_candidates, mode="ilql", iters=2)
+        )
         if ilql is not None:
             result["ilql_point"] = ilql
 
@@ -1000,8 +1023,14 @@ def _main_one(payload: str):
     try:
         result = run_one(tuple(spec["cand"]), **spec["kwargs"])
     except Exception as e:
+        # OOM outranks the transient class: a FAILED_PRECONDITION whose text
+        # also matches an allocator phrase means this process's memory is
+        # already poisoned (post-OOM state is unrecoverable in-process) —
+        # exit for the parent's clean-device size fallback, don't retry here.
+        if is_oom(e):
+            sys.exit(OOM_EXIT_CODE)
         if is_transient_compile_failure(e):
-            print("bench: transient remote-compile failure; retrying this size once", file=sys.stderr)
+            print("bench: transient backend failure; retrying this size once", file=sys.stderr)
             try:
                 result = run_one(tuple(spec["cand"]), **spec["kwargs"])
             except Exception as e2:
@@ -1010,8 +1039,6 @@ def _main_one(payload: str):
                 raise
             print(json.dumps(result))
             return
-        if is_oom(e):
-            sys.exit(OOM_EXIT_CODE)
         raise
     print(json.dumps(result))
 
