@@ -37,6 +37,7 @@ training loop (bench_reference.py → HEADTOHEAD.json), scope-labeled.
 import gc
 import json
 import os
+import re
 import sys
 import time
 
@@ -187,8 +188,11 @@ _OOM_PHRASES = (
     "out of memory",
     "failed to allocate",
     "allocation failed",
-    "oom",
 )
+# "oom" only as a whole word (plus the oom_kill/oomkilled variants) — a bare
+# substring would match unrelated text ("zoom", "bloomfilter", file paths)
+# and wrongly trigger the size fallback.
+_OOM_WORD = re.compile(r"\boom(?:_?kill(?:ed|er)?)?\b")
 
 
 def is_transient_compile_failure(e: Exception) -> bool:
@@ -206,7 +210,7 @@ def is_oom(e: Exception) -> bool:
     error is logged to stderr so a misclassification is visible in the bench
     transcript rather than silently becoming a smaller model size."""
     msg = str(e).lower()
-    hit = any(s in msg for s in _OOM_PHRASES)
+    hit = any(s in msg for s in _OOM_PHRASES) or bool(_OOM_WORD.search(msg))
     if hit:
         print(f"[bench] classified as OOM ({type(e).__name__}): {str(e)[:500]}", file=sys.stderr)
     return hit
@@ -355,6 +359,11 @@ def main():
         "gptj-l8-d4096-2.0B-w8-bf16": 8.6,
         "gptj-l8-d4096-2.0B-bf16": 12.7,
     }
+    # The expectations above were measured on one tunneled v5e; on any other
+    # chip generation a slower train phase is legitimate, so the degraded
+    # check only applies when the measured device kind matches (both spellings
+    # runtimes use for that chip, mirroring the HBM/TFLOP tables above).
+    EXPECTED_TRAIN_DEVICE_KINDS = ("v5 lite", "v5e")
     _knobs_overridden = any(
         os.environ.get(k)
         for k in (
@@ -376,7 +385,9 @@ def main():
     def _degraded(cand, result):
         exp = EXPECTED_TRAIN_SECONDS.get(cand[0])
         t = _train_seconds(result)
-        return bool(exp and t and not _knobs_overridden and t > 2.5 * exp)
+        kind = str((result or {}).get("device_kind", "")).lower()
+        kind_matches = any(k in kind for k in EXPECTED_TRAIN_DEVICE_KINDS)
+        return bool(exp and t and kind_matches and not _knobs_overridden and t > 2.5 * exp)
 
     def first_fitting(cands, **kwargs):
         for cand in cands:
@@ -493,27 +504,46 @@ def main():
                 h2h = json.load(f)
             if "reference" in h2h:  # legacy single-task layout
                 h2h = {"ilql": h2h}
+            # The headline metric is a PPO throughput number, so the primary
+            # `vs_baseline` carries the PPO ratio (same method); both methods
+            # are exposed symmetrically under vs_baseline_{ppo,ilql}_* keys.
             fields = {}
+            if "ppo" in h2h:
+                ppo = h2h["ppo"]
+                fields["vs_baseline"] = ppo["vs_baseline_samples_per_s"]
+                fields["vs_baseline_scope"] = (
+                    "CPU head-to-head vs the reference's own training loop "
+                    "(randomwalks PPO, identical dataset/protocol/metric — "
+                    "HEADTOHEAD.json; cold-compile included). Warm-cache: "
+                    f"{ppo.get('vs_baseline_warm_cache')}, full-step steady-state: "
+                    f"{ppo.get('vs_baseline_steady_state')}. Not the v4-32 gate."
+                )
+                fields["vs_baseline_ppo"] = ppo["vs_baseline_samples_per_s"]
+                fields["vs_baseline_ppo_warm_cache"] = ppo.get("vs_baseline_warm_cache")
+                fields["vs_baseline_ppo_steady_state"] = ppo.get("vs_baseline_steady_state")
+                fields["vs_baseline_ppo_steady_cycle"] = ppo.get("vs_baseline_steady_cycle")
             if "ilql" in h2h:
                 ilql = h2h["ilql"]
-                fields = {
-                    "vs_baseline": ilql["vs_baseline_samples_per_s"],
-                    "vs_baseline_scope": (
-                        "CPU head-to-head vs the reference's own training loop "
-                        "(randomwalks ILQL, identical dataset/protocol/metric — "
-                        "HEADTOHEAD.json; cold-compile included). Warm-cache: "
-                        f"{ilql.get('vs_baseline_warm_cache')}, full-step steady-state: "
-                        f"{ilql.get('vs_baseline_steady_state')}. Not the v4-32 gate."
-                    ),
-                    "vs_baseline_final_optimality": {
-                        "reference": ilql["reference"]["final_optimality"],
-                        "ours": ilql["ours"]["final_optimality"],
-                    },
+                fields["vs_baseline_ilql"] = ilql["vs_baseline_samples_per_s"]
+                fields["vs_baseline_ilql_warm_cache"] = ilql.get("vs_baseline_warm_cache")
+                fields["vs_baseline_ilql_steady_state"] = ilql.get("vs_baseline_steady_state")
+                fields["vs_baseline_final_optimality"] = {
+                    "reference": ilql["reference"]["final_optimality"],
+                    "ours": ilql["ours"]["final_optimality"],
                 }
-            if "ppo" in h2h:
-                fields["vs_baseline_ppo"] = h2h["ppo"]["vs_baseline_samples_per_s"]
-                fields["vs_baseline_ppo_warm_cache"] = h2h["ppo"].get("vs_baseline_warm_cache")
-                fields["vs_baseline_ppo_steady_state"] = h2h["ppo"].get("vs_baseline_steady_state")
+                if "vs_baseline" not in fields:
+                    # ILQL-only (or legacy single-task) file: a measured ratio
+                    # on disk must not surface as null — fall back with an
+                    # explicit cross-method scope label.
+                    fields["vs_baseline"] = ilql["vs_baseline_samples_per_s"]
+                    fields["vs_baseline_scope"] = (
+                        "CPU head-to-head vs the reference's own training loop "
+                        "(randomwalks ILQL — no PPO section in HEADTOHEAD.json; "
+                        "note the headline metric is a PPO throughput). "
+                        f"Warm-cache: {ilql.get('vs_baseline_warm_cache')}, "
+                        f"steady-state: {ilql.get('vs_baseline_steady_state')}. "
+                        "Not the v4-32 gate."
+                    )
             result.update(fields)
         except (KeyError, ValueError, TypeError) as e:
             print(f"bench: HEADTOHEAD.json unreadable ({e}); vs_baseline stays null", file=sys.stderr)

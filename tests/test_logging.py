@@ -1,0 +1,126 @@
+"""Tracker coverage: both the wandb branch and the JSONL fallback.
+
+The reference exercises its rank-0 wandb path in every run (init, scalar log,
+sample tables, Q/V/adv histograms; reference:
+trlx/model/accelerate_base_model.py:66-79,197 and
+trlx/model/nn/ilql_models.py:238-249). This container has no wandb, so the
+wandb branch is driven end-to-end with a recording stub so its first
+execution is not in production.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import trlx_tpu.utils.logging as tlog
+from trlx_tpu.utils.logging import Tracker
+
+
+class _StubRun:
+    def __init__(self):
+        self.logged = []
+        self.finished = False
+
+    def log(self, payload, step=None):
+        self.logged.append((payload, step))
+
+    def finish(self):
+        self.finished = True
+
+
+class _StubTable:
+    def __init__(self, columns, data):
+        self.columns = columns
+        self.data = data
+
+
+class _StubHistogram:
+    def __init__(self, values):
+        self.values = np.asarray(values)
+
+
+class _StubWandb:
+    Table = _StubTable
+    Histogram = _StubHistogram
+
+    def __init__(self):
+        self.run = _StubRun()
+        self.init_kwargs = None
+
+    def init(self, **kwargs):
+        self.init_kwargs = kwargs
+        return self.run
+
+
+def _drive(tracker):
+    tracker.log({"loss": 1.5, "tag": "x"}, step=3)
+    tracker.log_table("samples", ["prompt", "output"], [["a", "b"], ["c", "d"]], step=3)
+    tracker.log_histogram("qs", np.arange(8, dtype=np.float32), step=3)
+    tracker.finish()
+
+
+def test_jsonl_fallback_branch(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRLX_TPU_DISABLE_TRACKER", raising=False)
+    monkeypatch.delenv("debug", raising=False)
+    monkeypatch.setattr(tlog, "_HAS_WANDB", False)
+    tracker = Tracker("proj", config={"lr": 1e-4}, log_dir=str(tmp_path))
+    assert tracker.enabled and tracker._wandb is None
+    _drive(tracker)
+    lines = [json.loads(line) for line in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    kinds = [next(iter(rec)) for rec in lines]
+    assert kinds == ["_config", "step", "table", "histogram"]
+    assert lines[1]["loss"] == 1.5 and lines[1]["step"] == 3
+    assert lines[2]["rows"] == [["a", "b"], ["c", "d"]]
+    assert lines[3]["count"] == 8 and lines[3]["mean"] == pytest.approx(3.5)
+
+
+def test_wandb_branch_with_stub(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRLX_TPU_DISABLE_TRACKER", raising=False)
+    monkeypatch.delenv("debug", raising=False)
+    stub = _StubWandb()
+    monkeypatch.setattr(tlog, "wandb", stub)
+    monkeypatch.setattr(tlog, "_HAS_WANDB", True)
+    tracker = Tracker("proj", config={"lr": 1e-4}, run_name="run", entity_name="ent", log_dir=str(tmp_path))
+    assert tracker._wandb is stub.run
+    assert stub.init_kwargs["project"] == "proj"
+    assert stub.init_kwargs["name"] == "run"
+    assert stub.init_kwargs["entity"] == "ent"
+    _drive(tracker)
+    # scalar log, table log, histogram log — all routed through wandb AND the JSONL mirror
+    assert len(stub.run.logged) == 3
+    scalars, step = stub.run.logged[0]
+    assert scalars["loss"] == 1.5 and step == 3
+    table_payload, _ = stub.run.logged[1]
+    assert isinstance(table_payload["samples"], _StubTable)
+    assert table_payload["samples"].data == [["a", "b"], ["c", "d"]]
+    hist_payload, _ = stub.run.logged[2]
+    assert isinstance(hist_payload["qs"], _StubHistogram)
+    assert stub.run.finished
+    assert (tmp_path / "metrics.jsonl").exists()
+
+
+def test_disable_via_explicit_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRLX_TPU_DISABLE_TRACKER", "1")
+    tracker = Tracker("proj", log_dir=str(tmp_path))
+    assert not tracker.enabled
+    _drive(tracker)  # all no-ops, nothing written
+    assert not (tmp_path / "metrics.jsonl").exists()
+
+
+def test_disable_env_zero_means_enabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRLX_TPU_DISABLE_TRACKER", "0")
+    monkeypatch.delenv("debug", raising=False)
+    monkeypatch.setattr(tlog, "_HAS_WANDB", False)
+    tracker = Tracker("proj", log_dir=str(tmp_path))
+    assert tracker.enabled
+    tracker.finish()
+
+
+def test_legacy_debug_env_warns(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRLX_TPU_DISABLE_TRACKER", raising=False)
+    monkeypatch.setenv("debug", "")
+    with pytest.warns(DeprecationWarning, match="TRLX_TPU_DISABLE_TRACKER"):
+        tracker = Tracker("proj", log_dir=str(tmp_path))
+    assert not tracker.enabled

@@ -162,7 +162,10 @@ def load_or_init_params(model, config, rng) -> Dict[str, Any]:
         shardings = _tree_shardings(mesh, abstract)
         params = jax.jit(init_fn, out_shardings=shardings)(rng)
     else:
-        params = init_fn(rng)
+        # Jitted even single-device: one compiled program instead of hundreds
+        # of eagerly-dispatched initializer ops (~2x faster cold, and the
+        # program lands in the persistent compile cache for warm starts).
+        params = jax.jit(init_fn)(rng)
     mc = config.model
     if mc.model_path and not mc.model_arch:
         put = make_stream_put(params["transformer"])

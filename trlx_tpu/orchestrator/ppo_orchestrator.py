@@ -19,6 +19,8 @@ loader can prefetch (device work is enqueued, not awaited, until arrays are
 read) — the reference serializes these phases.
 """
 
+import time
+
 import jax
 import numpy as np
 
@@ -81,7 +83,14 @@ class PPOOrchestrator(Orchestrator):
         no per-sample Python objects."""
         n_collected = 0
         clock = Clock()
+        # Per-phase accounting (head-to-head attribution): generate-blocked,
+        # host decode+reward, device scoring, store push. With pipelining the
+        # generate time that host work hides does NOT show up in gen_s — it
+        # reports residual blocking, which is the honest pipelined cost.
+        gen_s = reward_s = score_s = push_s = 0.0
+        t = time.time()
         pending = self._generate_next_chunk()
+        gen_s += time.time() - t
         while True:
             tokens, mask, P, gen_aux = pending
             # Rows THIS process will store (num_rollouts is per-process, the
@@ -95,21 +104,25 @@ class PPOOrchestrator(Orchestrator):
                 )
             chunk_rows = int(tokens.shape[0]) // n_proc
             need_more = n_collected + chunk_rows < num_rollouts
+            t = time.time()
             if need_more:
                 pending = self._generate_next_chunk()
 
             # ONE device→host pull of the generation grids per chunk — both
             # reward paths and the store push reuse these host rows.
             tokens_h, mask_h = self.rl_model.to_local_host((tokens, mask))
+            gen_s += time.time() - t
 
             if getattr(self.rl_model, "has_reward_model", False):
                 # On-device learned RM: the whole scoring pass (policy
                 # logprobs/values, hydra ref KL, RM scores) is ONE fused
                 # sharded program — no decode, no host reward boundary.
+                t = time.time()
                 logprobs, values, rewards, kl, scores = self.rl_model.rollout_score_rm(
                     tokens, mask
                 )
                 scores = self.rl_model.to_local_host(scores)
+                score_s += time.time() - t
             else:
                 # Host boundary: decode → user reward_fn. Process-LOCAL on
                 # every host: these are this process's rows only, reward_fn
@@ -119,24 +132,31 @@ class PPOOrchestrator(Orchestrator):
                 # (the reference's per-rank reward_fn semantics,
                 # reference: trlx/orchestrator/ppo_orchestrator.py:73).
                 # Overlaps the pending generation running on device.
+                t = time.time()
                 texts_or_tokens = self.rl_model.decode(tokens_h, mask_h)
                 scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+                reward_s += time.time() - t
 
                 # Device: score rollouts. Fused: ref-branch replay only, the
                 # policy stats rode along with generation. Unfused: full
                 # policy forward + ref logits + KL rewards in one program.
+                t = time.time()
                 if gen_aux is not None:
                     logprobs, values, rewards, kl = self.rl_model.rollout_score_fused(
                         tokens, mask, scores, gen_aux
                     )
                 else:
                     logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
+                score_s += time.time() - t
 
             # Store holds process-local rows; put_batch re-shards them on the
             # way back to the device at train time.
+            t = time.time()
             logprobs, values, rewards, kl = self.rl_model.to_local_host(
                 (logprobs, values, rewards, kl)
             )
+            score_s += time.time() - t
+            t = time.time()
             self.rl_model.store.push_batch(
                 {
                     "query_tensors": tokens_h[:, :P],
@@ -148,6 +168,7 @@ class PPOOrchestrator(Orchestrator):
                     "rewards": rewards,
                 }
             )
+            push_s += time.time() - t
             n_collected += chunk_rows
             if not need_more:
                 break
@@ -157,6 +178,10 @@ class PPOOrchestrator(Orchestrator):
         self.rl_model.tracker.log(
             {
                 "exp_time": exp_time,
+                "exp_gen_s": gen_s,
+                "exp_reward_s": reward_s,
+                "exp_score_s": score_s,
+                "exp_push_s": push_s,
                 "rollout_mean_score": float(np.mean(scores)),
                 "rollout_mean_kl": float(np.mean(kl.sum(-1))),
             },

@@ -15,6 +15,7 @@ reference delegates to Accelerate/DeepSpeed is explicit here:
 import os
 import sys
 import time
+import warnings
 from abc import abstractmethod
 from typing import Any, Callable, Optional
 
@@ -22,7 +23,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+# Eager, not lazy-in-method: orbax's first import costs ~4 s and transformers'
+# ~5-6 s on one CPU core; paying them at package-import time (the reference
+# also imports transformers at module scope,
+# reference: trlx/model/accelerate_base_model.py:12-20) instead of inside the
+# first checkpoint / tokenizer build keeps those latencies honest.
+import orbax.checkpoint as ocp
 from flax import struct
+from transformers import AutoTokenizer
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import trainable_mask
@@ -41,6 +50,38 @@ class TrainState(struct.PyTreeNode):
     params: Any
     opt_state: Any
     extras: Any = None
+
+
+def lr_schedule(train_cfg):
+    """Warmup + cosine decay (reference: trlx/model/accelerate_base_model.py:93)."""
+    init, target = float(train_cfg.learning_rate_init), float(train_cfg.learning_rate_target)
+    decay_steps = max(train_cfg.lr_decay_steps, 1)
+    cosine = optax.cosine_decay_schedule(init, decay_steps, alpha=target / max(init, 1e-12))
+    if train_cfg.lr_ramp_steps > 0:
+        warmup = optax.linear_schedule(0.0, init, train_cfg.lr_ramp_steps)
+        return optax.join_schedules([warmup, cosine], [train_cfg.lr_ramp_steps])
+    return cosine
+
+
+def build_optimizer(train_cfg, opt_mask):
+    """(optimizer, schedule) from explicit ingredients — module-level so AOT
+    validation (tests/test_scale_compile.py) can build the production
+    optimizer against abstract params. multi_transform (not optax.masked):
+    masked would pass frozen params' raw gradients through untouched;
+    multi_transform routes them to set_to_zero, which both freezes them and
+    allocates no Adam moments for them."""
+    schedule = lr_schedule(train_cfg)
+    inner = optax.chain(
+        optax.clip_by_global_norm(train_cfg.grad_clip),
+        optax.adamw(
+            schedule,
+            b1=train_cfg.opt_betas[0],
+            b2=train_cfg.opt_betas[1],
+            weight_decay=train_cfg.weight_decay,
+        ),
+    )
+    labels = jax.tree_util.tree_map(lambda t: "train" if t else "freeze", opt_mask)
+    return optax.multi_transform({"train": inner, "freeze": optax.set_to_zero()}, labels), schedule
 
 
 class JaxBaseTrainer(BaseRLTrainer):
@@ -128,8 +169,6 @@ class JaxBaseTrainer(BaseRLTrainer):
     def _build_tokenizer(self, tokenizer_path: str):
         if not tokenizer_path:
             return None
-        from transformers import AutoTokenizer
-
         tokenizer = AutoTokenizer.from_pretrained(tokenizer_path)
         # pad = eos, left padding (reference:
         # trlx/model/accelerate_base_model.py:42-45); padding itself is done
@@ -139,14 +178,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         return tokenizer
 
     def _lr_schedule(self):
-        tc = self.config.train
-        init, target = float(tc.learning_rate_init), float(tc.learning_rate_target)
-        decay_steps = max(tc.lr_decay_steps, 1)
-        cosine = optax.cosine_decay_schedule(init, decay_steps, alpha=target / max(init, 1e-12))
-        if tc.lr_ramp_steps > 0:
-            warmup = optax.linear_schedule(0.0, init, tc.lr_ramp_steps)
-            return optax.join_schedules([warmup, cosine], [tc.lr_ramp_steps])
-        return cosine
+        return lr_schedule(self.config.train)
 
     def _build_optimizer(self):
         """AdamW + cosine schedule + global-norm clip
@@ -155,25 +187,8 @@ class JaxBaseTrainer(BaseRLTrainer):
         (reference: trlx/model/accelerate_base_model.py:49-64). Masked params
         get NO optimizer moments: layer freezing is also a ZeRO-style memory
         saving here."""
-        tc = self.config.train
-        self.schedule = self._lr_schedule()
-        inner = optax.chain(
-            optax.clip_by_global_norm(tc.grad_clip),
-            optax.adamw(
-                self.schedule,
-                b1=tc.opt_betas[0],
-                b2=tc.opt_betas[1],
-                weight_decay=tc.weight_decay,
-            ),
-        )
-        # NOTE: optax.masked would pass frozen params' raw gradients through
-        # untouched (it only skips the transform); multi_transform routes them
-        # to set_to_zero, which both freezes them and allocates no Adam
-        # moments for them.
-        labels = jax.tree_util.tree_map(lambda t: "train" if t else "freeze", self.opt_mask)
-        return optax.multi_transform(
-            {"train": inner, "freeze": optax.set_to_zero()}, labels
-        )
+        optimizer, self.schedule = build_optimizer(self.config.train, self.opt_mask)
+        return optimizer
 
     def build_trainable_mask(self, init_params):
         """Default layer-freezing mask (num_layers_unfrozen); subclasses
@@ -419,6 +434,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         on-device reward model (and no host reward_fn), eval rewards come
         from the RM."""
         self.end_progress()
+        eval_t0 = time.time()
         stats = {}
         all_texts = []
         rm_scores = []
@@ -480,6 +496,11 @@ class JaxBaseTrainer(BaseRLTrainer):
                     for row, item in zip(rows, v):
                         row.append(float(item))
         self.tracker.log_table("samples", columns, rows, step=self.iter_count)
+        # Total wall spent in eval — the component timers above (generate/
+        # reward/metric) undercount by the table/stat assembly; benchmarks
+        # excluding eval cost should use this, matching a wall-clock wrapper
+        # around the whole call (how the reference side is measured).
+        stats["eval_wall_time"] = time.time() - eval_t0
         return stats
 
     # ----------------------------------------------------------------- learn
@@ -582,7 +603,9 @@ class JaxBaseTrainer(BaseRLTrainer):
                 if self._preemption_agreed():
                     self._save_on_preemption()
                     return None
+                data_t0 = time.time()
                 device_batch = self.put_batch(batch)
+                self._data_s = getattr(self, "_data_s", 0.0) + (time.time() - data_t0)
                 for _ in range(self.n_updates_per_batch):
                     profiler_tick()
                     forward_t0 = time.time()
@@ -615,10 +638,22 @@ class JaxBaseTrainer(BaseRLTrainer):
                         stats_host["samples_per_sec"] = (
                             self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
                         )
+                        # Cumulative host→device batch-transfer seconds since
+                        # the last log (phase attribution: the "data" phase).
+                        stats_host["data_time"] = getattr(self, "_data_s", 0.0)
+                        self._data_s = 0.0
+                        # Wall since the previous log flushed: step_gap −
+                        # step_time = loop overhead outside the jitted step
+                        # (callbacks, intervals, logging, loader advance).
+                        # _last_log_t is re-stamped AFTER eval+log below so
+                        # eval wall never pollutes the next record's gap.
+                        if getattr(self, "_last_log_t", None) is not None:
+                            stats_host["step_gap"] = time.time() - self._last_log_t
                         if intervals["do_eval"]:
                             stats_host.update(self.evaluate())
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
+                        self._last_log_t = time.time()
 
                     # Independent of the log cadence (a nested check would
                     # silently thin the histograms to lcm(log, watch)).
@@ -664,13 +699,13 @@ class JaxBaseTrainer(BaseRLTrainer):
         (reference: trlx/model/accelerate_base_model.py:126-128)."""
         import json
 
-        import orbax.checkpoint as ocp
-
+        save_t0 = time.time()
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         name = f"state_{int(jax.device_get(self.state.step))}"
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.join(directory, name), self.state, force=True)
         ckptr.wait_until_finished()
+        self.tracker.log({"save_time": time.time() - save_t0}, step=self.iter_count)
         if is_main_process():
             with open(os.path.join(directory, f"{name}.host.json"), "w") as f:
                 json.dump(self.host_state_dict(), f)
@@ -734,8 +769,6 @@ class JaxBaseTrainer(BaseRLTrainer):
         the reference lacks)."""
         import json
 
-        import orbax.checkpoint as ocp
-
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         with open(os.path.join(directory, "latest.txt")) as f:
             name = f.read().strip()
@@ -760,10 +793,11 @@ class JaxBaseTrainer(BaseRLTrainer):
         """Sample continuations (reference protocol:
         trlx/model/__init__.py:57-71). `n_samples` rows are produced by tiling
         or truncating the prompt batch; `length` clips the response region to
-        at most the compiled response length (XLA shapes are static, so longer
-        requests are clipped, not recompiled). The generation batch is padded
-        up to a multiple of the mesh data axes (sharding requirement) and
-        sliced back afterwards."""
+        at most the compiled response length (XLA shapes are static, so a
+        request longer than `method.gen_kwargs` max tokens is clipped — with a
+        one-time warning — not recompiled). Note each NOVEL padded batch shape
+        (after rounding up to the mesh data axes) compiles a fresh generate
+        program; reuse batch sizes to stay on the cached executable."""
         ids = np.asarray(prompts["input_ids"])
         mask = np.asarray(prompts["attention_mask"])
         n = n_samples if n_samples is not None else ids.shape[0]
@@ -776,6 +810,15 @@ class JaxBaseTrainer(BaseRLTrainer):
         tokens = np.asarray(tokens)[:n]
         if length is not None:
             P = ids.shape[1]
-            end = P + min(int(length), tokens.shape[1] - P)
+            compiled = tokens.shape[1] - P
+            if int(length) > compiled and not getattr(self, "_warned_sample_clip", False):
+                self._warned_sample_clip = True
+                warnings.warn(
+                    f"sample(length={int(length)}) exceeds the compiled response "
+                    f"length {compiled}; output is clipped to {compiled} new tokens "
+                    "(raise method.gen_kwargs max tokens to generate more)",
+                    stacklevel=2,
+                )
+            end = P + min(int(length), compiled)
             tokens = tokens[:, :end]
         return tokens

@@ -404,51 +404,14 @@ class PPOTrainer(JaxBaseTrainer):
     # ------------------------------------------------------------ train step
 
     def build_train_step(self):
-        m = self.config.method
-        model = self.model
-        optimizer = self.optimizer
-        P = self.prompt_length
-
-        def loss_fn(params, batch: PPORLBatch):
-            params = self.detach_frozen(params)
-            all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
-            all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
-            out = model.apply({"params": params}, all_ids, all_mask, logits_start=P - 1)
-            logits = out["logits"].astype(jnp.float32)
-            lp = logprobs_from_logits(logits[:, :-1], all_ids[:, P:])
-            vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
-            return ppo_loss(
-                lp,
-                vpred,
-                batch.logprobs,
-                batch.values,
-                batch.rewards,
-                batch.response_mask,
-                gamma=m.gamma,
-                lam=m.lam,
-                cliprange=m.cliprange,
-                cliprange_value=m.cliprange_value,
-                vf_coef=m.vf_coef,
-            )
-
-        schedule = self.schedule
-
-        def train_step(state, batch: PPORLBatch):
-            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
-            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
-            stats = dict(stats)
-            stats["grad_norm"] = optax.global_norm(grads)
-            if self.config.train.watch_interval:
-                # per-group grad norms for the wandb.watch-equivalent; device
-                # scalars, fetched only at log boundaries with the rest
-                for group, sub in grads.items():
-                    stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
-            stats["learning_rate"] = schedule(state.step)
-            new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
-            return new_state, stats
-
-        return jax.jit(train_step, donate_argnums=(0,))
+        return make_ppo_train_step(
+            self.model,
+            self.optimizer,
+            self.config,
+            self.prompt_length,
+            self.schedule,
+            self.detach_frozen,
+        )
 
     def load_host_state(self, d: dict):
         super().load_host_state(d)
@@ -507,3 +470,54 @@ class PPOTrainer(JaxBaseTrainer):
             self.config.train.epochs * self.n_updates_per_batch * len(self.train_dataloader),
             self.config.train.total_steps,
         )
+
+
+def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detach_frozen):
+    """The jitted PPO update program, built from its explicit ingredients.
+
+    Factored out of PPOTrainer.build_train_step so AOT validation
+    (tests/test_scale_compile.py) can lower + compile the REAL production
+    step at 6B shapes from abstract arrays — without ever allocating the
+    parameters. The trainer method delegates here; there is exactly one
+    definition of the PPO update."""
+    m = config.method
+    P = prompt_length
+
+    def loss_fn(params, batch: PPORLBatch):
+        params = detach_frozen(params)
+        all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
+        all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
+        out = model.apply({"params": params}, all_ids, all_mask, logits_start=P - 1)
+        logits = out["logits"].astype(jnp.float32)
+        lp = logprobs_from_logits(logits[:, :-1], all_ids[:, P:])
+        vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
+        return ppo_loss(
+            lp,
+            vpred,
+            batch.logprobs,
+            batch.values,
+            batch.rewards,
+            batch.response_mask,
+            gamma=m.gamma,
+            lam=m.lam,
+            cliprange=m.cliprange,
+            cliprange_value=m.cliprange_value,
+            vf_coef=m.vf_coef,
+        )
+
+    def train_step(state, batch: PPORLBatch):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        stats = dict(stats)
+        stats["grad_norm"] = optax.global_norm(grads)
+        if config.train.watch_interval:
+            # per-group grad norms for the wandb.watch-equivalent; device
+            # scalars, fetched only at log boundaries with the rest
+            for group, sub in grads.items():
+                stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
+        stats["learning_rate"] = schedule(state.step)
+        new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+        return new_state, stats
+
+    return jax.jit(train_step, donate_argnums=(0,))

@@ -3,15 +3,18 @@
 The reference is wandb-centric through Accelerate
 (reference: trlx/model/accelerate_base_model.py:31,66-79,244). This container
 has no wandb and no egress, so the tracker degrades gracefully: rank-0 writes
-`<checkpoint_dir>/metrics.jsonl` and prints compact lines. The `debug` env var
-disables tracking entirely, matching the reference's behavior
-(reference: trlx/model/accelerate_base_model.py:72-79).
+`<checkpoint_dir>/metrics.jsonl` and prints compact lines. Setting
+`TRLX_TPU_DISABLE_TRACKER` disables tracking entirely — the explicit
+counterpart of the reference's generic `debug` env switch
+(reference: trlx/model/accelerate_base_model.py:72-79). The old generic
+`debug` name is still honored with a deprecation warning for one release.
 """
 
 import json
 import os
 import sys
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 try:
@@ -25,6 +28,20 @@ except Exception:
 from trlx_tpu.parallel.mesh import is_main_process
 
 
+def _tracker_disabled() -> bool:
+    if "TRLX_TPU_DISABLE_TRACKER" in os.environ:
+        return os.environ["TRLX_TPU_DISABLE_TRACKER"] not in ("", "0")
+    if "debug" in os.environ:
+        warnings.warn(
+            "the generic `debug` env var for disabling the tracker is deprecated; "
+            "set TRLX_TPU_DISABLE_TRACKER=1 instead",
+            DeprecationWarning,
+            stacklevel=3,
+        )
+        return True
+    return False
+
+
 class Tracker:
     def __init__(
         self,
@@ -34,15 +51,14 @@ class Tracker:
         entity_name: Optional[str] = None,
         log_dir: str = "ckpts",
     ):
-        self.enabled = is_main_process() and "debug" not in os.environ
+        self.enabled = is_main_process() and not _tracker_disabled()
         self._wandb = None
         self._file = None
         if not self.enabled:
             return
         if _HAS_WANDB:
-            mode = "disabled" if "debug" in os.environ else "online"
             self._wandb = wandb.init(
-                project=project_name, name=run_name, entity=entity_name, config=config, mode=mode
+                project=project_name, name=run_name, entity=entity_name, config=config
             )
         os.makedirs(log_dir, exist_ok=True)
         self._file = open(os.path.join(log_dir, "metrics.jsonl"), "a")
