@@ -1,15 +1,35 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast quality style bench bench-reference
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 quality style bench bench-reference acceptance-network
+
+TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 # Full suite (learning gates, multihost, kernels): nightly / pre-release.
+# Exceeds a 10-min single-command budget — use the three shards below for
+# full-suite green within per-command limits (timings: README "Testing").
 test:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	python -m pytest tests/ -q
+	$(TEST_ENV) python -m pytest tests/ -q
 
 # Fast tier: per-commit CI signal, < ~3 min on CPU.
 test-fast:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	python -m pytest tests/ -q -m "not slow"
+	$(TEST_ENV) python -m pytest tests/ -q -m "not slow"
+
+# Full-suite green in three bounded commands: shard1 = fast tier + kernel/
+# generate slow tests; shard2 = e2e learning gates; shard3 = mesh/multihost/
+# scale. Every test runs in exactly one shard.
+test-shard1:
+	$(TEST_ENV) python -m pytest tests/ -q -m "not slow" \
+	    && $(TEST_ENV) python -m pytest -q -m slow \
+	        tests/test_flash.py tests/test_ring_attention.py tests/test_generate.py \
+	        tests/test_weight_quant.py tests/test_hf_stream.py
+
+test-shard2:
+	$(TEST_ENV) python -m pytest -q -m slow \
+	    tests/test_e2e.py tests/test_text_mode.py tests/test_softprompt.py \
+	    tests/test_fused_rollout.py
+
+test-shard3:
+	$(TEST_ENV) python -m pytest -q -m slow \
+	    tests/test_mesh.py tests/test_multihost.py tests/test_scale_compile.py
 
 quality:
 	ruff check trlx_tpu/ tests/ examples/ bench.py
@@ -23,3 +43,9 @@ bench:
 # CPU head-to-head vs the reference's own training loop (writes HEADTOHEAD.json).
 bench-reference:
 	python bench_reference.py
+
+# Network-day acceptance: the four reference acceptance examples + gates in
+# one command, distilled to ACCEPTANCE.json (RUNBOOK.md). Offline it still
+# runs end-to-end with every test skipped — that's the smoke path CI covers.
+acceptance-network:
+	TRLX_TPU_NETWORK=1 python acceptance_network.py

@@ -1011,6 +1011,22 @@ def run_one_ilql(cand, iters=None):
         device_sync(tokens)
     t_dec = (time.time() - t0) / dec_iters
 
+    # Plain-sampling ablation: the same model/params/shapes WITHOUT advantage
+    # steering (no Q/V carry, no per-step head evals, default logit chain) —
+    # the measured price of ILQL's steered decode vs vanilla sampling.
+    from trlx_tpu.ops.generate import make_generate_fn as _mk_gen
+
+    plain_fn = _mk_gen(trainer.model, trainer.gen_cfg)
+    swapped = {"params": {**trainer.state.params, **trainer.state.extras}}
+    batch_io = trainer.put_batch({"i": prompt_ids, "m": pmask})
+    ptok, _ = plain_fn(swapped, batch_io["i"], batch_io["m"], trainer.next_rng())  # compile
+    device_sync(ptok)
+    t0 = time.time()
+    for _ in range(dec_iters):
+        ptok, _ = plain_fn(swapped, batch_io["i"], batch_io["m"], trainer.next_rng())
+        device_sync(ptok)
+    t_plain = (time.time() - t0) / dec_iters
+
     n_chips = jax.device_count()
     sps_per_chip = steps * B / t_train / n_chips
     decode_tps_per_chip = B * R / t_dec / n_chips
@@ -1041,6 +1057,38 @@ def run_one_ilql(cand, iters=None):
     }
     if peak:
         out["ilql_train_mfu_pct"] = round(100 * train_tflops / peak, 2)
+
+    out["plain_decode_tokens_per_s_per_chip"] = round(B * R / t_plain / n_chips, 1)
+    out["steering_overhead_pct"] = round(100.0 * (t_dec - t_plain) / max(t_plain, 1e-9), 1)
+
+    # ---- decode HBM roofline (same honesty the PPO point gets): modeled
+    # bytes the steered decode must move per batch — trunk + lm_head weights
+    # re-read every step, the two (target) Q heads + V head the steering
+    # evaluates per step, and the growing KV cache — over the measured decode
+    # seconds net of a modeled prefill (prefill FLOPs at the measured train
+    # MFU, the same large-batch-matmul proxy the PPO model uses).
+    bw_gbps = detect_hbm_gbps()
+    if bw_gbps and peak and t_dec > 0:
+        # trunk/head param bytes follow param_dtype (ILQL has no W8 path)
+        pb = 2.0 if config.model.param_dtype == "bfloat16" else 4.0
+        kvb = 1.0 if config.model.kv_cache_quant else 2.0
+        head_bytes = 2 * (d * 2 * d + 2 * d * V) + (d * 2 * d + 2 * d)
+        step_weight_bytes = (L * 12 * d * d + V * d + head_bytes) * pb
+        kv_bytes = B * L * 2 * d * kvb * (R * (P + T) / 2 + R)
+        decode_bytes = R * step_weight_bytes + kv_bytes
+        prefill_flops = lm_flops(L, d, V, B * P, P / 2, B)
+        mfu = max(train_tflops / peak, 1e-3)
+        t_prefill = prefill_flops / (peak * 1e12 * mfu)
+        t_decode = max(t_dec - t_prefill, 1e-6)
+        out["decode_hbm_util_pct"] = round(100.0 * decode_bytes / t_decode / (bw_gbps * 1e9), 1)
+        out["decode_hbm_model"] = {
+            "peak_hbm_gbps": bw_gbps,
+            "decode_seconds_modeled": round(t_decode, 3),
+            "prefill_seconds_modeled": round(t_prefill, 3),
+            "weight_bytes_per_step_gb": round(step_weight_bytes / 1e9, 3),
+            "head_bytes_per_step_gb": round(head_bytes * pb / 1e9, 3),
+            "kv_bytes_total_gb": round(kv_bytes / 1e9, 3),
+        }
     return out
 
 

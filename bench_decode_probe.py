@@ -1,0 +1,122 @@
+"""Decode-bandwidth attribution probe: split decode time into HBM traffic vs fixed per-step cost.
+
+BENCH_r04 measured the flagship decode at 58.7% of the modeled HBM roofline.
+This probe answers WHERE the other 41% goes: it measures the SAME flagship
+decode under quantization/chunk combinations whose modeled byte traffic is
+known, then least-squares fits
+
+    t_decode(combo) = bytes(combo) / BW_eff  +  R * c0
+
+over the combos — BW_eff is the bandwidth the decode loop actually achieves
+on its memory traffic, c0 the fixed per-decode-step cost (kernel issue,
+while_loop step overhead, sampling, cache-index bookkeeping) that no byte
+reduction can touch. If BW_eff is near peak, the utilization gap is
+latency-bound (c0·R dominates), not bandwidth-bound — the falsifiable form
+of VERDICT r4's ask.
+
+Runs each combo through `python bench.py` (subprocess OOM isolation, the
+same measurement path as the published flagship) with optional points off.
+Writes DECODE_PROBE.json. Real TPU, ~20 min.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(REPO, "DECODE_PROBE.json")
+
+# (label, env overrides). Chunk 32 is the published flagship shape; the
+# W8/KV combos scan weight and cache bytes independently; chunk 64 halves
+# per-token weight traffic (weights are read once per step, shared by rows).
+COMBOS = [
+    ("w8-kv8-c32", {"BENCH_W8": "1", "BENCH_KV_QUANT": "1", "BENCH_CHUNK": "32"}),
+    ("w8-kv16-c32", {"BENCH_W8": "1", "BENCH_KV_QUANT": "0", "BENCH_CHUNK": "32"}),
+    ("w16-kv8-c32", {"BENCH_W8": "0", "BENCH_KV_QUANT": "1", "BENCH_CHUNK": "32"}),
+    ("w16-kv16-c32", {"BENCH_W8": "0", "BENCH_KV_QUANT": "0", "BENCH_CHUNK": "32"}),
+    ("w8-kv8-c64", {"BENCH_W8": "1", "BENCH_KV_QUANT": "1", "BENCH_CHUNK": "64"}),
+]
+
+
+def run_combo(label, overrides):
+    env = dict(os.environ)
+    env.update(overrides)
+    env.update(
+        BENCH_ORCH="0",           # serialized decode/score/train phases only
+        BENCH_FP32_POINT="0",
+        BENCH_ILQL_POINT="0",
+        BENCH_ITERS="2",
+    )
+    t = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        print(f"[probe] {label} FAILED rc={proc.returncode}\n{proc.stderr[-1500:]}", file=sys.stderr)
+        return None
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    model = rec.get("decode_hbm_model")
+    if not model:
+        print(f"[probe] {label}: no decode_hbm_model in output", file=sys.stderr)
+        return None
+    m = rec["metric"]  # ppo_samples_per_sec_per_chip[name,seqT,prefillP+decodeR,chunkC,bB]
+    R = int(m.split("+decode")[1].split(",")[0])
+    out = {
+        "label": label,
+        "R": R,
+        "decode_seconds": model["decode_seconds_modeled"],
+        "bytes_gb": model["weight_bytes_per_step_gb"] * R + model["kv_bytes_total_gb"],
+        "util_pct": rec.get("decode_hbm_util_pct"),
+        "peak_hbm_gbps": model["peak_hbm_gbps"],
+        "samples_per_s_per_chip": rec["value"],
+        "wall_s": round(time.time() - t, 1),
+    }
+    print(f"[probe] {label}: t_dec={out['decode_seconds']}s bytes={out['bytes_gb']:.1f}GB "
+          f"util={out['util_pct']}% ({out['wall_s']}s)", flush=True)
+    return out
+
+
+def fit(points):
+    """Least squares for t = bytes/BW + R*c0 → returns (BW GB/s, c0 ms)."""
+    A = np.array([[p["bytes_gb"], p["R"]] for p in points], dtype=np.float64)
+    t = np.array([p["decode_seconds"] for p in points], dtype=np.float64)
+    # unknowns x = [1/BW (s/GB), c0 (s/step)]
+    x, residuals, *_ = np.linalg.lstsq(A, t, rcond=None)
+    inv_bw, c0 = float(x[0]), float(x[1])
+    bw = 1.0 / max(inv_bw, 1e-12)
+    pred = A @ x
+    return bw, c0, [round(float(p), 3) for p in pred]
+
+
+def main():
+    points = [p for p in (run_combo(l, o) for l, o in COMBOS) if p]
+    result = {"points": points, "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    if len(points) >= 3:
+        bw, c0, pred = fit(points)
+        peak = points[0]["peak_hbm_gbps"]
+        result["fit"] = {
+            "achieved_bw_gbps": round(bw, 1),
+            "achieved_bw_frac_of_peak": round(bw / peak, 3),
+            "fixed_cost_ms_per_step": round(1e3 * c0, 3),
+            "predicted_decode_seconds": pred,
+            "model": "t_decode = bytes/BW_eff + R*c0 (least squares over combos)",
+        }
+        # attribution of the flagship's utilization gap
+        flag = points[0]
+        t_bw = flag["bytes_gb"] / bw
+        result["fit"]["flagship_share_bandwidth_pct"] = round(100 * t_bw / flag["decode_seconds"], 1)
+        result["fit"]["flagship_share_fixed_pct"] = round(
+            100 * (flag["R"] * c0) / flag["decode_seconds"], 1
+        )
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"probe": "done", "fit": result.get("fit"), "out": OUT}))
+
+
+if __name__ == "__main__":
+    main()

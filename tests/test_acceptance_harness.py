@@ -1,0 +1,38 @@
+"""Offline smoke of the network-day acceptance harness.
+
+The four reference acceptance examples can only EXECUTE with egress
+(RUNBOOK.md); this keeps `make acceptance-network` itself from bitrotting:
+run the harness with network off, assert it completes, classifies every test
+as skipped, and writes a well-formed ACCEPTANCE.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_acceptance_harness_offline(tmp_path):
+    out = tmp_path / "ACCEPTANCE.json"
+    env = dict(os.environ)
+    env.pop("TRLX_TPU_NETWORK", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import sys; sys.path.insert(0, %r); import acceptance_network as a; "
+        "r = a.main(out_path=%r); sys.exit(0 if r['status'] == 'skipped-no-network' else 2)"
+        % (REPO, str(out))
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO)
+    assert proc.returncode == 0
+
+    result = json.loads(out.read_text())
+    assert result["status"] == "skipped-no-network"
+    assert set(result["tests"]) == {
+        "test_ppo_sentiments", "test_ilql_sentiments", "test_ppo_gptj",
+        "test_simulacra", "test_architext",
+    }
+    for t, rec in result["tests"].items():
+        assert rec["outcome"] == "skipped", (t, rec)
+        assert rec["trajectory"] == []
+        assert rec["reference_config"]

@@ -1,0 +1,82 @@
+"""Fused decode-attention kernel vs the einsum reference math (interpret mode).
+
+The kernel's contract: bit-comparable attention output to the model layer's
+einsum decode path — including the dequant-folding identity
+(ks·dot(K_int8, q) == dot(K_int8·ks, q) up to fp32 reassociation) and the
+additive bias masking. CPU CI runs the same kernel code via pallas
+interpret mode (the on-TPU routing gate is tested separately)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.lm import quantize_kv
+from trlx_tpu.ops.decode_attention import decode_attn_eligible, decode_attention
+
+pytestmark = pytest.mark.slow
+
+
+def _reference_einsum(q, k, v, bias_row, scale):
+    """The model layer's decode einsum path, verbatim math."""
+    scores = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale + bias_row[:, None, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
+
+
+def _setup(B=2, T=64, h=2, d=128, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(B, h, d)).astype(np.float32)
+    k = rng.normal(size=(B, T, h, d)).astype(np.float32)
+    v = rng.normal(size=(B, T, h, d)).astype(np.float32)
+    # validity mask with left padding + causal tail invalid
+    valid = np.ones((B, T), dtype=bool)
+    valid[0, :5] = False
+    valid[1, T - 8 :] = False
+    bias = np.where(valid, 0.0, -1e9).astype(np.float32)
+    return q, k, v, bias
+
+
+def test_plain_matches_einsum():
+    q, k, v, bias = _setup()
+    out = decode_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, None,
+        jnp.asarray(bias), scale=0.125, interpret=True,
+    )
+    ref = _reference_einsum(q, k, v, bias, 0.125)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_quant_matches_dequantized_einsum():
+    q, k, v, bias = _setup(seed=1)
+    kq, ks = quantize_kv(jnp.asarray(k))
+    vq, vs = quantize_kv(jnp.asarray(v))
+    out = decode_attention(
+        jnp.asarray(q), kq, vq, ks, vs, jnp.asarray(bias), scale=0.125, interpret=True,
+    )
+    # reference: dequantize then einsum — the exact model-layer fallback
+    k_dq = kq.astype(jnp.float32) * ks[..., None].astype(jnp.float32)
+    v_dq = vq.astype(jnp.float32) * vs[..., None].astype(jnp.float32)
+    ref = _reference_einsum(q, k_dq, v_dq, bias, 0.125)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_fully_masked_rows_are_finite():
+    q, k, v, bias = _setup(seed=2)
+    bias[0, :] = -1e9  # every key invalid for row 0
+    out = decode_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, None,
+        jnp.asarray(bias), scale=0.125, interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_eligibility_gate():
+    # off-TPU the gate must refuse (einsum path stands in CI)
+    assert not decode_attn_eligible(16, 256, 1024, True) or jax.default_backend() == "tpu"
+    if jax.default_backend() == "tpu":
+        assert decode_attn_eligible(16, 256, 1024, True)
+        assert not decode_attn_eligible(16, 200, 1024, True)  # lanes not 128-aligned
+        assert not decode_attn_eligible(16, 256, 1000, True)  # int8 sublane tile

@@ -28,12 +28,18 @@ pytestmark = [
 
 
 def _trajectory(checkpoint_dir, key):
-    """All values of `key` logged to the run's metrics.jsonl, in order."""
+    """All values of `key` logged to the run's metrics.jsonl, in order.
+    A `histogram:<name>` key reads the mean of that logged histogram (the
+    Tracker stores summary stats for histograms, utils/logging.py)."""
     vals = []
+    hist = key.split(":", 1)[1] if key.startswith("histogram:") else None
     with open(os.path.join(checkpoint_dir, "metrics.jsonl")) as f:
         for line in f:
             rec = json.loads(line)
-            if key in rec:
+            if hist is not None:
+                if rec.get("histogram") == hist:
+                    vals.append(float(rec["mean"]))
+            elif key in rec:
                 vals.append(float(rec[key]))
     return vals
 
@@ -126,3 +132,45 @@ def test_ppo_gptj(tmp_path):
         config=config,
     )
     _assert_learned(_trajectory(str(tmp_path), "mean_reward"), 0.8, 0.15, "ppo_gptj mean_reward")
+
+
+def test_simulacra(tmp_path):
+    """Offline ILQL on Simulacra aesthetic ratings (reference:
+    examples/simulacra.py). No task metric_fn exists, so the gate is on the
+    eval generations' mean value-head estimate ("metrics" are the rating
+    scale 1-10): the advantage-steered sampler's mean predicted return must
+    improve ≥0.3 over the run's first eval."""
+    import simulacra
+    import trlx_tpu
+    from trlx_tpu.trainer.api import default_config
+
+    config = default_config("ilql")
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = int(os.environ.get("TRLX_TPU_NETWORK_STEPS", 400))
+
+    prompts, ratings = simulacra.load_ratings(str(tmp_path / "sac.sqlite"))
+    trlx_tpu.train("gpt2", dataset=(prompts, ratings), eval_prompts=["Hatsune Miku, Red Dress"] * 64, config=config)
+    vals = _trajectory(str(tmp_path), "histogram:decode/vs")
+    _assert_learned(vals, 6.0, 0.3, "simulacra mean predicted rating (V head)")
+
+
+def test_architext(tmp_path):
+    """PPO room-count reward on architext/gptj-162M (reference:
+    examples/architext.py). Reward = −(":" count); gate: mean reward improves
+    ≥0.5 rooms over the run's first eval (fewer rooms drawn)."""
+    import architext
+    import trlx_tpu
+    from trlx_tpu.trainer.api import default_config
+
+    config = default_config("ppo")
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.total_steps = int(os.environ.get("TRLX_TPU_NETWORK_STEPS", 400))
+
+    trlx_tpu.train(
+        "architext/gptj-162M",
+        reward_fn=architext.reward_fn,
+        prompts=architext.PROMPTS,
+        eval_prompts=architext.PROMPTS,
+        config=config,
+    )
+    _assert_learned(_trajectory(str(tmp_path), "mean_reward"), -1.0, 0.5, "architext mean_reward")

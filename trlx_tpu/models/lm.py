@@ -72,6 +72,17 @@ class LMConfig:
     # the sp-axis ring (trlx_tpu/parallel/ring_attention.py). Set by the
     # trainer from the mesh; 0/1 disables.
     sp_size: int = 0
+    # Sharded-mesh training: compute the token embedding as one_hot @ table
+    # instead of a gather. A gather's backward is a scatter-add whose
+    # activation-grad resharding the SPMD partitioner cannot express over a
+    # (dp,fsdp)-batch → (tp,fsdp)-table layout (it falls back to full
+    # rematerialization — full-tensor replication traffic per step on a
+    # pod); matmul gradients shard cleanly (partial dW + psum/reduce-scatter
+    # over the data axes). One-hot rows are exact (1.0·x bit-exact in bf16),
+    # FLOP cost is <1% of a train step at 6B shapes. Set by the trainer when
+    # the mesh is sharded; single-device keeps the cheaper gather. Decode
+    # always gathers (no gradients).
+    onehot_embed: bool = False
     # int8 KV cache (per-token-per-head absmax scales): decode attention is
     # HBM-bandwidth-bound on cache reads at scale — int8 halves that traffic
     # and halves cache memory (longer sequences / larger rollout chunks per
@@ -327,7 +338,12 @@ class Attention(nn.Module):
             k = apply_rotary(k, sin, cos, rd, neox)
 
         new_cache = None
+        decode_kernel_kv = None  # set → route this step through the fused
+        # pallas decode-attention kernel (single-token, cache-resident)
         if cache is not None:
+            from trlx_tpu.ops.decode_attention import decode_attn_eligible
+
+            single_step = q_len == 1 and attn_bias is not None
             if cfg.kv_cache_quant:
                 k_cache, v_cache, ks_cache, vs_cache = cache
                 kq, ks = quantize_kv(k)
@@ -338,11 +354,17 @@ class Attention(nn.Module):
                 vs_cache = jax.lax.dynamic_update_slice(vs_cache, vs, (0, cache_index, 0))
                 new_cache = (k_cache, v_cache, ks_cache, vs_cache)
                 if flash_mask is None:
-                    # Dequantize on read: XLA fuses int8→compute convert +
-                    # scale into the attention contraction's operand load, so
-                    # HBM traffic is the int8 bytes.
-                    k = k_cache.astype(dtype) * ks_cache[..., None].astype(dtype)
-                    v = v_cache.astype(dtype) * vs_cache[..., None].astype(dtype)
+                    if single_step and decode_attn_eligible(
+                        cfg.n_head, hd, int(k_cache.shape[1]), True
+                    ):
+                        # Kernel reads the int8 cache directly (dequant is
+                        # folded into the attention algebra) — HBM traffic
+                        # is exactly the int8 bytes.
+                        decode_kernel_kv = (k_cache, v_cache, ks_cache, vs_cache)
+                    else:
+                        # Dequantize on read for the einsum path.
+                        k = k_cache.astype(dtype) * ks_cache[..., None].astype(dtype)
+                        v = v_cache.astype(dtype) * vs_cache[..., None].astype(dtype)
             else:
                 k_cache, v_cache = cache
                 k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
@@ -354,7 +376,12 @@ class Attention(nn.Module):
                 # prefill) attend over the cache buffers with the
                 # cache-validity bias.
                 if flash_mask is None:
-                    k, v = k_cache, v_cache
+                    if single_step and decode_attn_eligible(
+                        cfg.n_head, hd, int(k_cache.shape[1]), False
+                    ):
+                        decode_kernel_kv = (k_cache, v_cache, None, None)
+                    else:
+                        k, v = k_cache, v_cache
 
         scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
         if flash_mask is not None:
@@ -372,6 +399,16 @@ class Attention(nn.Module):
                     q, k, v, flash_mask, scale=scale, causal=True, window=window,
                     block_q=blk, block_k=blk,
                 ).astype(dtype)
+        elif decode_kernel_kv is not None:
+            from trlx_tpu.ops.decode_attention import decode_attention
+
+            kc, vc, ksc, vsc = decode_kernel_kv
+            # attn_bias is [b, 1, 1, kv] on a single-token step; the kernel
+            # takes the one bias row (causality + validity + local window
+            # are all already encoded in it).
+            out = decode_attention(
+                q[:, 0], kc, vc, ksc, vsc, attn_bias[:, 0, 0, :], scale=scale
+            ).astype(dtype)
         else:
             # [b, n_head, q, kv] scores in fp32 for a stable softmax.
             scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
@@ -489,7 +526,13 @@ class TransformerLM(nn.Module):
             cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="wte"
         )
         if inputs_embeds is None:
-            x = wte(input_ids)
+            if cfg.onehot_embed and cache is None:
+                # Training/scoring forward on a sharded mesh: one-hot matmul
+                # (see LMConfig.onehot_embed). Decode keeps the gather.
+                onehot = jax.nn.one_hot(input_ids, cfg.vocab_size, dtype=cfg.compute_dtype)
+                x = onehot @ wte.embedding.astype(cfg.compute_dtype)
+            else:
+                x = wte(input_ids)
         else:
             x = inputs_embeds.astype(cfg.compute_dtype)
 

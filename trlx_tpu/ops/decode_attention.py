@@ -1,0 +1,134 @@
+"""Pallas TPU fused single-token decode attention over the (int8) KV cache.
+
+Decode is HBM-bound on cache reads. The XLA einsum path for a decode step
+dequantizes the int8 cache into materialized bf16 k/v before the
+contraction (trlx_tpu/models/lm.py Attention decode branch) — measured on a
+v5e, that costs ~387 us/layer/step at [B=32, T=832, h=16, d=256] against an
+int8-bytes floor of ~266 us (DECODE_PROBE.json: ~4.7 ms/step of decode time
+the byte model couldn't explain). This kernel reads the int8 cache
+DIRECTLY and folds dequantization into the attention algebra, so the HBM
+traffic is exactly the int8 bytes:
+
+    scores[t] = ks[t] * dot(K_int8[t, :], q) * scale       (per-key scale
+    out[d]    = sum_t softmax(scores)[t] * vs[t] * V_int8[t, d]   factors out)
+
+Grid (batch, head): each program streams one head's whole cache row
+[T, head_dim] through VMEM — no [T, T] score matrix, no dequantized copy,
+one pass. Masking is the same additive bias row the einsum path uses.
+Inference-only (decode never differentiates) — no VJP.
+
+The reference has no counterpart (HF `generate` materializes fp16 caches,
+reference: trlx/model/accelerate_base_model.py:105-116); this is the
+TPU-native design the hardware wants. Engagement mirrors flash_attention:
+real TPU backend + tile-aligned shapes, else the einsum path stands
+(interpret mode keeps CPU CI coverage, tests/test_decode_attention.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.flash_attention import _HAVE_PLTPU, _interpret_default, pl
+
+if _HAVE_PLTPU:  # pragma: no branch
+    from jax.experimental.pallas import tpu as pltpu
+else:  # pragma: no cover
+    pltpu = None
+
+
+def _vmem(shape, index_map):
+    if _HAVE_PLTPU:
+        return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _attend_rows(q2, k, bias, ks, scale):
+    """Unnormalized fp32 attention weights [T, 1] + their sum [1, 1].
+    All operands stay 2-D (TPU vector layout)."""
+    scores = jax.lax.dot_general(
+        k, q2, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [T, 1] = K @ q
+    scores = scores * scale
+    if ks is not None:
+        scores = scores * ks  # per-key int8 scale, factored out of the dot
+    scores = scores + bias
+    m = jnp.max(scores, axis=0, keepdims=True)
+    p = jnp.exp(scores - m)  # [T, 1]
+    return p, jnp.sum(p, axis=0, keepdims=True)
+
+
+def _kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref, *, scale):
+    q2 = q_ref[0, 0, :].reshape(-1, 1).astype(jnp.float32)         # [d, 1]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)                      # [T, d]
+    ks = ks_ref[0, :, 0].reshape(-1, 1).astype(jnp.float32)        # [T, 1]
+    bias = bias_ref[0, :].reshape(-1, 1)                           # [T, 1]
+    p, s = _attend_rows(q2, k, bias, ks, scale)
+    vs = vs_ref[0, :, 0].reshape(-1, 1).astype(jnp.float32)
+    w = (p * vs) / s                                               # [T, 1]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)                      # [T, d]
+    out = jax.lax.dot_general(
+        w, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [1, d]
+    o_ref[0, 0, :] = out[0, :].astype(o_ref.dtype)
+
+
+def _kernel_plain(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
+    q2 = q_ref[0, 0, :].reshape(-1, 1).astype(jnp.float32)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)
+    bias = bias_ref[0, :].reshape(-1, 1)
+    p, s = _attend_rows(q2, k, bias, None, scale)
+    w = p / s
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    out = jax.lax.dot_general(
+        w, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    o_ref[0, 0, :] = out[0, :].astype(o_ref.dtype)
+
+
+def decode_attn_eligible(n_head: int, head_dim: int, cache_len: int, quant: bool) -> bool:
+    """Static routing: real TPU + tile-aligned shapes (int8 sublane tile is
+    32, bf16 16; lanes 128). Mirrors auto_flash_ok's spirit — off-TPU the
+    einsum path is faster than interpreted pallas."""
+    if not _HAVE_PLTPU or jax.default_backend() != "tpu":
+        return False
+    sublane = 32 if quant else 16
+    return head_dim % 128 == 0 and cache_len % sublane == 0
+
+
+def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale, interpret=None):
+    """Single-token attention over the cache.
+
+    q: [B, h, d] (this step's query). k_cache/v_cache: [B, T, h, d] — int8
+    when ks/vs (per-slot scales [B, T, h]) are given, else the compute
+    dtype. bias_row: [B, T] additive fp32 mask row (0 valid / -1e9 invalid —
+    the einsum path's bias, one row). Returns [B, 1, h, d] in q.dtype."""
+    B, h, d = q.shape
+    T = k_cache.shape[1]
+    interpret = _interpret_default() if interpret is None else interpret
+    grid = (B, h)
+    q_spec = _vmem((1, 1, d), lambda b, j: (b, j, 0))
+    kv_spec = _vmem((1, T, 1, d), lambda b, j: (b, 0, j, 0))
+    sc_spec = _vmem((1, T, 1), lambda b, j: (b, 0, j))
+    bias_spec = _vmem((1, T), lambda b, j: (b, 0))
+    out_spec = _vmem((1, 1, d), lambda b, j: (b, j, 0))
+    out_shape = jax.ShapeDtypeStruct((B, h, d), q.dtype)
+    if ks is not None:
+        out = pl.pallas_call(
+            functools.partial(_kernel_quant, scale=scale),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec, sc_spec, sc_spec, bias_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q, k_cache, v_cache, ks, vs, bias_row)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_kernel_plain, scale=scale),
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q, k_cache, v_cache, bias_row)
+    return out[:, None]  # [B, 1, h, d]

@@ -307,12 +307,17 @@ class JaxBaseTrainer(BaseRLTrainer):
 
     def finalize_lm_config(self, lm_cfg):
         """Inject mesh-derived settings the architecture needs statically:
-        sp>1 turns on ring-attention sequence parallelism."""
+        sp>1 turns on ring-attention sequence parallelism; any sharded mesh
+        switches the training-path embedding to the one-hot matmul whose
+        gradients the SPMD partitioner shards without falling back to full
+        rematerialization (LMConfig.onehot_embed)."""
         from trlx_tpu.parallel.mesh import AXIS_SP
 
         sp = int(self.mesh.shape[AXIS_SP])
         if sp > 1:
             lm_cfg = lm_cfg.replace(sp_size=sp)
+        if int(self.mesh.size) > 1:
+            lm_cfg = lm_cfg.replace(onehot_embed=True)
         return lm_cfg
 
     # ------------------------------------------------------------- abstracts
