@@ -9,7 +9,9 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 test:
 	$(TEST_ENV) python -m pytest tests/ -q
 
-# Fast tier: per-commit CI signal, < ~3 min on CPU.
+# Fast tier: per-commit CI signal, < ~4 min on CPU. Includes the resilience
+# suite (tests/test_resilience.py — fault drills, guard/watchdog/checkpoint
+# hardening): single-process CPU drills, so nothing there needs a slow mark.
 test-fast:
 	$(TEST_ENV) python -m pytest tests/ -q -m "not slow"
 

@@ -1,0 +1,479 @@
+"""Resilience subsystem tests (trlx_tpu/resilience/): fault injection,
+non-finite guard, divergence watchdog + rollback, checkpoint hardening.
+
+Everything runs on CPU in the fast tier — the FaultPlan harness makes the
+failure paths (NaN grads, reward_fn exceptions/hangs, corrupted checkpoints,
+preemption SIGTERM) reproducible without a TPU or a real eviction.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.resilience import (  # noqa: E402
+    CheckpointError,
+    DivergenceWatchdog,
+    FaultInjected,
+    FaultPlan,
+    TrainingDiverged,
+    all_finite,
+    call_with_retries,
+    guarded_update,
+    poison_nan,
+)
+from trlx_tpu.resilience import checkpoint as ckpt_util  # noqa: E402
+from trlx_tpu.trainer.base import lr_schedule  # noqa: E402
+
+
+# ----------------------------------------------------------------- fault plan
+
+
+def test_fault_plan_parse_fire_once_and_env_override(monkeypatch):
+    plan = FaultPlan.parse("nan_grad@3,reward_exc@2, sigterm@5")
+    assert bool(plan)
+    assert not plan.fire("nan_grad", 2)
+    assert plan.fire("nan_grad", 3)
+    assert not plan.fire("nan_grad", 3)  # fires exactly once
+    assert plan.fire("reward_exc", 2) and plan.fire("sigterm", 5)
+
+    assert not FaultPlan.parse("")  # empty spec = no faults
+
+    with pytest.raises(ValueError):
+        FaultPlan.parse("explode@1")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("nan_grad@x")
+
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "ckpt_corrupt@1")
+    plan = FaultPlan.from_env_or_config("nan_grad@3")
+    assert plan.fire("ckpt_corrupt", 1)
+    assert not plan.fire("nan_grad", 3)  # env var replaced the config spec
+
+
+def test_poison_nan_floats_only():
+    tree = {"f": jnp.ones((3,), jnp.float32), "i": jnp.ones((3,), jnp.int32)}
+    out = poison_nan(tree)
+    assert np.isnan(np.asarray(out["f"])).all()
+    assert np.array_equal(np.asarray(out["i"]), np.ones(3, np.int32))
+
+
+# ---------------------------------------------------------- non-finite guard
+
+
+def test_all_finite_flags_nan_and_skips_int_leaves():
+    ok = {"a": jnp.ones((2, 2)), "i": jnp.arange(3)}
+    bad = {"a": jnp.asarray([1.0, float("nan")]), "i": jnp.arange(3)}
+    assert bool(jax.device_get(all_finite(ok)))
+    assert not bool(jax.device_get(all_finite(bad)))
+    # int-only trees are trivially finite (isfinite would reject them)
+    assert bool(jax.device_get(all_finite({"i": jnp.arange(3)})))
+
+
+def test_guarded_update_skips_nonfinite_and_counts_consecutive():
+    optimizer = optax.adam(1e-1)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt_state = optimizer.init(params)
+    bad = jnp.zeros((), jnp.int32)
+    step = jax.jit(lambda g, loss, p, s, b: guarded_update(optimizer, g, loss, p, s, b))
+
+    good_grads = {"w": jnp.full((4,), 0.5, jnp.float32)}
+    nan_grads = {"w": jnp.asarray([0.5, float("nan"), 0.5, 0.5], jnp.float32)}
+
+    # finite step: params move, counter stays 0
+    p1, s1, bad1, finite1 = step(good_grads, jnp.asarray(1.0), params, opt_state, bad)
+    assert bool(jax.device_get(finite1))
+    assert int(jax.device_get(bad1)) == 0
+    assert not np.allclose(np.asarray(p1["w"]), np.asarray(params["w"]))
+
+    # NaN grads: params AND opt_state pass through bitwise unchanged
+    p2, s2, bad2, finite2 = step(nan_grads, jnp.asarray(1.0), p1, s1, bad1)
+    assert not bool(jax.device_get(finite2))
+    assert int(jax.device_get(bad2)) == 1
+    assert np.array_equal(np.asarray(p2["w"]), np.asarray(p1["w"]))
+    for new, old in zip(jax.tree_util.tree_leaves(s2), jax.tree_util.tree_leaves(s1)):
+        assert np.array_equal(np.asarray(new), np.asarray(old))
+    # no NaN ever reached the Adam moments
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in jax.tree_util.tree_leaves(s2))
+
+    # NaN LOSS alone (finite grads) also skips; counter is consecutive
+    p3, s3, bad3, _ = step(good_grads, jnp.asarray(float("nan")), p2, s2, bad2)
+    assert int(jax.device_get(bad3)) == 2
+    # a finite step resets the consecutive counter
+    _, _, bad4, _ = step(good_grads, jnp.asarray(1.0), p3, s3, bad3)
+    assert int(jax.device_get(bad4)) == 0
+
+
+# ------------------------------------------------------------------ watchdog
+
+
+def test_watchdog_requires_positive_threshold():
+    with pytest.raises(ValueError):
+        DivergenceWatchdog(0.0)
+
+
+def test_watchdog_triggers_on_sustained_divergence_only():
+    wd = DivergenceWatchdog(threshold=0.5, patience=2, ema_alpha=0.5, warmup=2)
+    # warmup: even a spike must not trigger while the baseline settles
+    assert not wd.observe(100.0)
+    assert not wd.observe(1.0)
+    # settled around ~O(10); a single spike is not "sustained"
+    assert not wd.observe(1000.0)
+    assert wd.breaches == 1
+    assert not wd.observe(1.0)  # recovery resets the consecutive count
+    assert wd.breaches == 0
+    # sustained: patience consecutive breaches trigger
+    assert not wd.observe(1000.0)
+    assert wd.observe(1000.0)
+    # breaching values must NOT have dragged the EMA up to the divergence
+    assert wd.ema < 100.0
+
+    wd.reset()
+    assert wd.breaches == 0 and wd.ema is None
+
+    # non-finite losses past warmup count as breaches too
+    wd2 = DivergenceWatchdog(threshold=0.5, patience=2, warmup=0)
+    wd2.observe(1.0)
+    assert not wd2.observe(float("nan"))
+    assert wd2.observe(float("inf"))
+
+
+# --------------------------------------------------------------------- retry
+
+
+def test_call_with_retries_recovers_exhausts_and_times_out():
+    calls = {"n": 0}
+
+    def flaky_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FaultInjected("first call fails")
+        return "ok"
+
+    assert call_with_retries(flaky_once, retries=2, backoff=0.0) == "ok"
+    assert calls["n"] == 2
+
+    def always_fails():
+        raise FaultInjected("no luck")
+
+    with pytest.raises(FaultInjected, match="no luck"):
+        call_with_retries(always_fails, retries=1, backoff=0.0)
+
+    # hang watchdog: first call sleeps past the timeout, retry succeeds
+    state = {"n": 0}
+
+    def hangs_once():
+        state["n"] += 1
+        if state["n"] == 1:
+            time.sleep(1.0)
+        return state["n"]
+
+    assert call_with_retries(hangs_once, retries=1, backoff=0.0, timeout=0.1) == 2
+
+    with pytest.raises(TimeoutError):
+        call_with_retries(lambda: time.sleep(1.0), retries=0, backoff=0.0, timeout=0.1)
+
+
+# ------------------------------------------------------- checkpoint hardening
+
+
+def _fake_checkpoint(directory, step, payload=b"x" * 4096):
+    name = f"state_{step}"
+    path = os.path.join(directory, name, "shard")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "data.bin"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(directory, name, "meta.json"), "w") as f:
+        f.write("{}")
+    ckpt_util.write_manifest(directory, name, step)
+    return name
+
+
+def test_atomic_write_replaces_not_appends(tmp_path):
+    p = str(tmp_path / "latest.txt")
+    ckpt_util.atomic_write_text(p, "state_1")
+    ckpt_util.atomic_write_text(p, "state_22")
+    with open(p) as f:
+        assert f.read() == "state_22"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]  # no litter
+
+
+def test_manifest_verifies_and_catches_truncation(tmp_path):
+    d = str(tmp_path)
+    name = _fake_checkpoint(d, 3)
+    ok, reason = ckpt_util.verify_checkpoint(d, name)
+    assert ok, reason
+
+    rel = ckpt_util.corrupt_checkpoint(d, name)  # truncates the largest file
+    assert rel is not None
+    ok, reason = ckpt_util.verify_checkpoint(d, name)
+    assert not ok and "truncated" in reason
+
+    # a missing manifest (pre-manifest checkpoint) passes with a note
+    os.remove(ckpt_util.manifest_path(d, name))
+    ok, reason = ckpt_util.verify_checkpoint(d, name)
+    assert ok and "no manifest" in reason
+
+    # a missing directory never verifies
+    ok, _ = ckpt_util.verify_checkpoint(d, "state_404")
+    assert not ok
+
+
+def test_gc_keeps_newest_and_protected(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        _fake_checkpoint(d, step)
+        ckpt_util.atomic_write_json(os.path.join(d, f"state_{step}.host.json"), {})
+
+    assert ckpt_util.gc_checkpoints(d, keep=0) == []  # 0 disables retention
+    removed = ckpt_util.gc_checkpoints(d, keep=2, protect=("state_1",))
+    assert removed == ["state_2"]  # state_1 protected, 3+4 newest
+    assert ckpt_util.list_checkpoints(d) == ["state_4", "state_3", "state_1"]
+    # sidecars of the removed checkpoint are gone too
+    assert not os.path.exists(os.path.join(d, "state_2.host.json"))
+    assert not os.path.exists(ckpt_util.manifest_path(d, "state_2"))
+
+
+# ------------------------------------------------------------- trainer level
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def small_config(**train_overrides):
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    for k, v in train_overrides.items():
+        setattr(config.train, k, v)
+    return config
+
+
+def make_trainer(task, ckpt_dir, **train_overrides):
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    _, logit_mask, metric_fn, reward_fn = task
+    config = small_config(checkpoint_dir=str(ckpt_dir), **train_overrides)
+    return PPOTrainer(
+        config, reward_fn=reward_fn, metric_fn=metric_fn, logit_mask=logit_mask
+    )
+
+
+def test_load_without_any_checkpoint_is_actionable(task, tmp_path):
+    trainer = make_trainer(task, tmp_path / "ck")
+    with pytest.raises(CheckpointError, match="resume_from_checkpoint"):
+        trainer.load(str(tmp_path / "empty"))
+
+    # latest.txt pointing at a checkpoint that no longer exists: a clear
+    # CheckpointError naming the candidate, not a raw FileNotFoundError
+    d = tmp_path / "dangling"
+    os.makedirs(d)
+    ckpt_util.atomic_write_text(str(d / "latest.txt"), "state_99")
+    with pytest.raises(CheckpointError, match="state_99"):
+        trainer.load(str(d))
+
+
+def test_async_save_defers_sidecars_until_finalize(task, tmp_path):
+    d = str(tmp_path / "ck")
+    trainer = make_trainer(task, d, async_checkpointing=True)
+    trainer.save(d, block=False)
+    # the pointer only flips at finalize — a crash mid-async-save must leave
+    # the previous checkpoint as the resume point
+    assert not os.path.exists(os.path.join(d, "latest.txt"))
+    name = trainer._finalize_pending_save()
+    assert name == "state_0"
+    with open(os.path.join(d, "latest.txt")) as f:
+        assert f.read().strip() == "state_0"
+    ok, reason = ckpt_util.verify_checkpoint(d, "state_0")
+    assert ok, reason
+    assert os.path.exists(os.path.join(d, "state_0.host.json"))
+
+
+def test_save_retention_and_fallback_restore(task, tmp_path):
+    d = str(tmp_path / "ck")
+    trainer = make_trainer(task, d, keep_checkpoints=2)
+    for _ in range(3):  # saves state_0, state_1, state_2
+        trainer.save(d)
+        trainer.state = trainer.state.replace(step=trainer.state.step + 1)
+    assert ckpt_util.list_checkpoints(d) == ["state_2", "state_1"]  # GC'd state_0
+
+    # corrupt the latest: load() must fall back to the previous intact one
+    ckpt_util.corrupt_checkpoint(d, "state_2")
+    trainer.load(d)
+    assert trainer.last_restore_fallback is True
+    assert int(jax.device_get(trainer.state.step)) == 1
+
+
+def test_max_bad_steps_aborts_with_clear_error(task, tmp_path):
+    trainer = make_trainer(task, tmp_path / "ck", max_bad_steps=3)
+    trainer._res_pending = [
+        (jnp.asarray(float("nan")), jnp.asarray(1.0), jnp.asarray(3.0))
+    ]
+    with pytest.raises(TrainingDiverged, match="max_bad_steps"):
+        trainer._flush_resilience()
+    assert trainer.skipped_steps == 1
+
+
+def test_watchdog_rollback_restores_and_decays_lr(task, tmp_path):
+    d = str(tmp_path / "ck")
+    trainer = make_trainer(
+        task,
+        d,
+        watchdog_threshold=0.5,
+        watchdog_patience=2,
+        watchdog_warmup=1,
+        watchdog_lr_decay=0.5,
+        max_rollbacks=2,
+    )
+    trainer.save(d)  # the good state at step 0
+    trainer.state = trainer.state.replace(step=trainer.state.step + 5)
+    trainer.iter_count = 5
+
+    losses = [1.0, 1.0, 100.0, 100.0]  # settle, then sustained divergence
+    trainer._res_pending = [(jnp.asarray(v), None, None) for v in losses]
+    trainer._flush_resilience()
+
+    assert int(jax.device_get(trainer.state.step)) == 0  # rolled back
+    assert trainer.iter_count == 0
+    assert trainer._rollbacks == 1
+    assert trainer._lr_scale == pytest.approx(0.5)
+    assert trainer.watchdog.breaches == 0  # reset for the resumed run
+    # the LR the train step will actually use is scaled
+    base_lr = float(lr_schedule(trainer.config.train)(10))  # past warmup
+    assert base_lr > 0
+    assert float(trainer.schedule(10)) == pytest.approx(0.5 * base_lr)
+    # a restored (pre-rollback) host state must not reset the safety budget
+    trainer.load_host_state({"resilience": {"rollbacks": 0, "lr_scale": 1.0}})
+    assert trainer._rollbacks == 1
+    assert trainer._lr_scale == pytest.approx(0.5)
+
+    # budget exhausted → abort instead of looping forever
+    trainer._rollbacks = trainer.config.train.max_rollbacks
+    with pytest.raises(TrainingDiverged, match="max_rollbacks"):
+        trainer._rollback()
+
+
+def test_reward_fn_faults_are_retried(task, tmp_path):
+    """reward_exc / reward_hang fire through the orchestrator's hardened
+    score(): one bounded retry each, training never sees the failure."""
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    trainer = make_trainer(
+        task,
+        tmp_path / "ck",
+        fault_plan="reward_exc@1,reward_hang@2",
+        reward_fn_timeout=0.2,
+        reward_fn_retries=2,
+        reward_fn_backoff=0.0,
+    )
+    calls = {"n": 0}
+    real_reward_fn = trainer.reward_fn
+
+    def counting_reward(texts):
+        calls["n"] += 1
+        return real_reward_fn(texts)
+
+    pipeline = PromptPipeline([[1]] * 16, tokenizer=None, max_prompt_length=1)
+    orch = PPOOrchestrator(trainer, pipeline, counting_reward, chunk_size=16)
+    scores = orch.score([np.asarray([1, 2, 0])] * 16)
+    assert np.asarray(scores).shape == (16,)  # call 1: exception, then retry
+    scores = orch.score([np.asarray([1, 2, 0])] * 16)
+    assert np.asarray(scores).shape == (16,)  # call 2: hang, timeout, retry
+    assert all(f.fired for f in trainer.fault_plan.faults)
+
+    # with no retries left the failure surfaces as the injected error
+    trainer.fault_plan = FaultPlan.parse("reward_exc@3")
+    trainer.config.train.reward_fn_retries = 0
+    with pytest.raises(FaultInjected):
+        orch.score([np.asarray([1, 2, 0])] * 16)
+
+
+def test_preemption_resume_restores_step_and_rng(task, tmp_path):
+    """Satellite: SIGTERM mid-run → checkpoint lands → a fresh trainer with
+    resume_from_checkpoint=True continues from the saved step with the
+    identical host RNG."""
+    _, logit_mask, metric_fn, reward_fn = task
+    d = str(tmp_path / "ck")
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    config = small_config(
+        checkpoint_dir=d, total_steps=50, epochs=100, fault_plan="sigterm@2"
+    )
+    model = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+    assert model.iter_count == 2  # preempted, not finished
+    with open(os.path.join(d, "latest.txt")) as f:
+        assert f.read().strip() == "state_2"
+    with open(os.path.join(d, "state_2.host.json")) as f:
+        saved = json.load(f)
+
+    resumed = make_trainer(task, d, resume_from_checkpoint=True)
+    assert resumed._resumed
+    assert int(jax.device_get(resumed.state.step)) == 2
+    assert [int(x) for x in np.asarray(jax.device_get(resumed.rng)).reshape(-1)] == saved["rng"]
+
+
+def test_fault_drill_full_recovery(task, tmp_path):
+    """The acceptance drill: one run absorbs an injected reward_fn exception,
+    a NaN-grad step, a corrupted checkpoint, and a synthetic SIGTERM; the
+    follow-up run falls back past the corrupted checkpoint and finishes with
+    a finite loss."""
+    _, logit_mask, metric_fn, reward_fn = task
+    d = str(tmp_path / "ck")
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    def run(fault_plan, resume):
+        config = small_config(
+            checkpoint_dir=d,
+            checkpoint_interval=2,
+            fault_plan=fault_plan,
+            resume_from_checkpoint=resume,
+            reward_fn_backoff=0.0,
+        )
+        return trlx_tpu.train(
+            reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+            metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+        )
+
+    # Run 1: reward_exc on the first reward call (retried), NaN grads at
+    # step 3 (guard skips the update), interval saves at steps 2 and 4, then
+    # SIGTERM after step 5 → preemption save state_5 (the 3rd completed
+    # save), which ckpt_corrupt@3 truncates post-commit.
+    first = run("reward_exc@1,nan_grad@3,ckpt_corrupt@3,sigterm@5", resume=False)
+    assert first.iter_count == 5  # preempted before total_steps=8
+    assert first.skipped_steps > 0  # the guard skipped the NaN step
+    assert all(f.fired for f in first.fault_plan.faults)
+    with open(os.path.join(d, "latest.txt")) as f:
+        assert f.read().strip() == "state_5"
+    ok, _ = ckpt_util.verify_checkpoint(d, "state_5")
+    assert not ok  # latest really is corrupt
+
+    # Run 2: resume. latest (state_5) fails manifest verification → fall
+    # back to state_4 → train the remaining steps to completion.
+    second = run("", resume=True)
+    assert second.last_restore_fallback is True
+    assert second.iter_count == 8
+    assert int(jax.device_get(second.state.step)) == 8
+
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    losses = [r["loss"] for r in recs if "loss" in r]
+    assert losses and np.isfinite(losses[-1])

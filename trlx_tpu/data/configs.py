@@ -132,6 +132,38 @@ class TrainConfig:
     # 0.995x cold vs 1.117x warm).
     compile_cache_dir: Optional[str] = None
 
+    # --- resilience (trlx_tpu/resilience/) ---
+    # On-device non-finite guard: the jitted train step skips the parameter
+    # update (params/opt_state pass through unchanged) when grads or loss go
+    # NaN/inf, and counts consecutive skips in TrainState.bad_steps.
+    nonfinite_guard: bool = True
+    # Abort with TrainingDiverged after this many CONSECUTIVE skipped steps
+    # (persistent numeric blow-up, not a one-off bad batch). 0 disables.
+    max_bad_steps: int = 8
+    # Retention: keep only the N newest state_* checkpoints (the one
+    # latest.txt points at is always kept). 0 = keep everything.
+    keep_checkpoints: int = 0
+    # Divergence watchdog: roll back to the last intact checkpoint when the
+    # per-step loss exceeds ema + threshold*max(|ema|,1) for `patience`
+    # consecutive observations. threshold 0 = watchdog off.
+    watchdog_threshold: float = 0.0
+    watchdog_patience: int = 4
+    watchdog_ema_alpha: float = 0.9
+    watchdog_warmup: int = 5
+    # Multiply the learning rate by this on every rollback (1.0 = no decay).
+    watchdog_lr_decay: float = 0.5
+    # Abort with TrainingDiverged after this many watchdog rollbacks.
+    max_rollbacks: int = 2
+    # Host reward_fn hardening (PPO orchestrator): hang timeout in seconds
+    # (0 = none), bounded retries, exponential backoff base.
+    reward_fn_timeout: float = 0.0
+    reward_fn_retries: int = 2
+    reward_fn_backoff: float = 0.5
+    # Fault-injection plan, e.g. "nan_grad@3,reward_exc@2,ckpt_corrupt@1,
+    # sigterm@5" (see trlx_tpu/resilience/faults.py). The TRLX_TPU_FAULTS
+    # env var overrides this field. Empty = no faults.
+    fault_plan: str = ""
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         cfg = dict(config)

@@ -25,6 +25,8 @@ import jax
 import numpy as np
 
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.resilience.faults import FaultInjected
+from trlx_tpu.resilience.retry import call_with_retries
 from trlx_tpu.utils import Clock
 
 
@@ -35,6 +37,7 @@ class PPOOrchestrator(Orchestrator):
         self.chunk_size = chunk_size
         self.pipeline_loader = self.pipeline.create_loader(self.chunk_size, shuffle=True)
         self.pipeline_iterator = iter(self.pipeline_loader)
+        self._reward_calls = 0
 
         # Inject callbacks into the trainer (reference:
         # trlx/orchestrator/ppo_orchestrator.py:41-43).
@@ -44,8 +47,35 @@ class PPOOrchestrator(Orchestrator):
 
     def score(self, texts):
         """User reward on decoded samples
-        (reference: trlx/orchestrator/ppo_orchestrator.py:45-49)."""
-        return self.rl_model.reward_fn(texts)
+        (reference: trlx/orchestrator/ppo_orchestrator.py:45-49).
+
+        Hardened: reward_fn is arbitrary user Python, usually crossing a
+        network/subprocess boundary — a transient exception or hang costs a
+        bounded retry (train.reward_fn_retries / _backoff / _timeout), not
+        the run. Fault kinds reward_exc / reward_hang inject both failure
+        modes, keyed on the reward-call number."""
+        t = self.rl_model.config.train
+        self._reward_calls += 1
+        call_index = self._reward_calls
+        fault_plan = getattr(self.rl_model, "fault_plan", None)
+
+        def call():
+            if fault_plan is not None:
+                if fault_plan.fire("reward_exc", call_index):
+                    raise FaultInjected(f"injected reward_fn exception (call {call_index})")
+                if fault_plan.fire("reward_hang", call_index):
+                    # Sleep well past the timeout so the hang watchdog, not
+                    # luck, decides the outcome.
+                    time.sleep(max(t.reward_fn_timeout, 0.1) * 3)
+            return self.rl_model.reward_fn(texts)
+
+        return call_with_retries(
+            call,
+            retries=t.reward_fn_retries,
+            backoff=t.reward_fn_backoff,
+            timeout=t.reward_fn_timeout,
+            description="reward_fn",
+        )
 
     def _generate_next_chunk(self, fused=None):
         """`fused=None` follows the trainer's fused_rollout setting; False

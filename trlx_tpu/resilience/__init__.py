@@ -1,0 +1,70 @@
+"""Training resilience subsystem: detect bad steps, recover automatically,
+prove it with injected faults.
+
+Production-scale RLHF treats preemption, host flakiness, and numeric
+blow-ups as routine events (LlamaRL / PipelineRL, PAPERS.md); the reference
+trlx has no failure story at all ("crash = job death", SURVEY.md §5). Four
+pillars, wired through trainer/base.py, trainer/ppo.py, trainer/ilql.py and
+the PPO orchestrator:
+
+1. **On-device non-finite guard** (`guard.py`) — the jitted train step
+   computes an all-finite flag over grads+loss and passes params/opt_state
+   through unchanged on bad steps; consecutive skips are counted on device
+   and the host aborts with a clear error after ``train.max_bad_steps``.
+2. **Divergence watchdog + rollback** (`watchdog.py`) — a host-side EMA
+   monitor over buffered per-step loss scalars; sustained divergence
+   restores the last manifest-valid checkpoint, decays the learning rate,
+   and resumes (``resilience/*`` metrics flow through the Tracker).
+3. **Checkpoint hardening** (`checkpoint.py`) — atomic ``latest.txt`` /
+   sidecar writes via ``os.replace``, a per-checkpoint manifest (step, file
+   checksums, framework versions), a ``train.keep_checkpoints`` retention
+   policy, and manifest-verified ``load()`` with fallback to the previous
+   intact checkpoint when the latest is corrupt or half-written.
+4. **Fault injection** (`faults.py`) — a config/env-driven ``FaultPlan``
+   (``TRLX_TPU_FAULTS="nan_grad@3,reward_exc@2,ckpt_corrupt@1,sigterm@5"``)
+   that poisons gradients, raises/hangs ``reward_fn`` calls (wrapped with
+   timeout + bounded retry in the orchestrator, `retry.py`), truncates
+   checkpoint files, and delivers synthetic SIGTERM — the harness that makes
+   pillars 1-3 verifiable on CPU (tests/test_resilience.py).
+"""
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when training cannot continue: too many consecutive non-finite
+    steps (``train.max_bad_steps``) or too many watchdog rollbacks
+    (``train.max_rollbacks``)."""
+
+
+from trlx_tpu.resilience.checkpoint import (  # noqa: E402
+    CheckpointError,
+    atomic_write_json,
+    atomic_write_text,
+    corrupt_checkpoint,
+    gc_checkpoints,
+    list_checkpoints,
+    verify_checkpoint,
+    write_manifest,
+)
+from trlx_tpu.resilience.faults import FaultInjected, FaultPlan, poison_nan  # noqa: E402
+from trlx_tpu.resilience.guard import all_finite, guarded_update  # noqa: E402
+from trlx_tpu.resilience.retry import call_with_retries  # noqa: E402
+from trlx_tpu.resilience.watchdog import DivergenceWatchdog  # noqa: E402
+
+__all__ = [
+    "TrainingDiverged",
+    "CheckpointError",
+    "FaultInjected",
+    "FaultPlan",
+    "DivergenceWatchdog",
+    "all_finite",
+    "guarded_update",
+    "call_with_retries",
+    "poison_nan",
+    "atomic_write_text",
+    "atomic_write_json",
+    "write_manifest",
+    "verify_checkpoint",
+    "list_checkpoints",
+    "gc_checkpoints",
+    "corrupt_checkpoint",
+]

@@ -1,0 +1,62 @@
+"""On-device non-finite guard for jitted train steps.
+
+A single bad batch (inf reward, fp overflow, a flaky host feeding NaN) must
+not poison the parameters or the Adam moments: `guarded_update` computes an
+all-finite flag over the gradients and loss INSIDE the jitted step and
+selects between the updated and the untouched state with `tree_map(where)` —
+the XLA-friendly form of "skip this optimizer step". The consecutive-skip
+counter rides in ``TrainState.bad_steps`` so it survives checkpoints and
+costs no host sync; the host reads it from the step's stats at log
+boundaries and aborts after ``train.max_bad_steps`` (trainer/base.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every element of every inexact leaf is finite.
+
+    Integer/bool leaves (token ids, masks, optimizer counts) are skipped —
+    they cannot be non-finite and `isfinite` rejects them.
+    """
+    checks = [
+        jnp.all(jnp.isfinite(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not checks:
+        return jnp.asarray(True)
+    return functools.reduce(jnp.logical_and, checks)
+
+
+def guarded_update(optimizer, grads, loss, params, opt_state, bad_steps):
+    """Apply `optimizer` only when grads+loss are finite; otherwise pass
+    params and opt_state through unchanged and bump the consecutive-skip
+    counter.
+
+    Returns ``(params, opt_state, bad_steps, finite)``. On a bad step the
+    gradients are zeroed BEFORE ``optimizer.update`` so NaN/inf can never
+    reach the Adam moments even transiently (a global-norm clip of NaN grads
+    would otherwise produce NaN updates whose state we'd have to discard
+    anyway); the `where`-select then keeps the ORIGINAL state, so the zeroed
+    update is dead code on the bad branch — it exists only to keep the
+    program shape identical on both branches (XLA requires it).
+    """
+    finite = jnp.logical_and(all_finite(grads), all_finite(loss))
+    safe_grads = jax.tree_util.tree_map(
+        lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+    )
+    updates, new_opt_state = optimizer.update(safe_grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+
+    def keep_if_finite(new, old):
+        return jnp.where(finite, new, old)
+
+    params_out = jax.tree_util.tree_map(keep_if_finite, new_params, params)
+    opt_out = jax.tree_util.tree_map(keep_if_finite, new_opt_state, opt_state)
+    bad_out = jnp.where(finite, jnp.zeros_like(bad_steps), bad_steps + 1)
+    return params_out, opt_out, bad_out, finite

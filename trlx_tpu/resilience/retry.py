@@ -1,0 +1,73 @@
+"""Bounded retry + hang timeout for host-side callables.
+
+The user ``reward_fn`` is arbitrary Python crossing a network or subprocess
+boundary more often than not (sentiment pipelines, judge APIs) — a transient
+exception or a hang must cost one bounded retry, not the whole run. The PPO
+orchestrator wraps its reward calls here, governed by
+``train.reward_fn_timeout`` / ``reward_fn_retries`` / ``reward_fn_backoff``.
+"""
+
+import sys
+import threading
+import time
+
+
+def _run_with_timeout(fn, timeout: float):
+    """Run ``fn()`` in a daemon thread; raise TimeoutError if it outlives
+    `timeout` seconds. The hung thread is abandoned (daemon=True so it cannot
+    block interpreter exit) — acceptable for the read-only host callables
+    this guards; a wedged thread's eventual result is discarded."""
+    box = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller thread
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(f"call still running after {timeout}s")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def call_with_retries(
+    fn,
+    *,
+    retries: int = 2,
+    backoff: float = 0.5,
+    timeout: float = 0.0,
+    description: str = "call",
+):
+    """``fn()`` with up to `retries` retries on exception or timeout.
+
+    ``timeout <= 0`` disables the hang watchdog (fn runs on the caller
+    thread). Backoff doubles per attempt starting at `backoff` seconds.
+    The final failure re-raises the last underlying error.
+    """
+    attempts = max(int(retries), 0) + 1
+    last_error = None
+    for attempt in range(attempts):
+        try:
+            if timeout and timeout > 0:
+                return _run_with_timeout(fn, timeout)
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — bounded, re-raised below
+            last_error = e
+            if attempt + 1 >= attempts:
+                break
+            delay = backoff * (2**attempt)
+            print(
+                f"[trlx_tpu.resilience] {description} failed "
+                f"(attempt {attempt + 1}/{attempts}: {type(e).__name__}: {e}) — "
+                f"retrying in {delay:.2g}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            if delay > 0:
+                time.sleep(delay)
+    raise last_error

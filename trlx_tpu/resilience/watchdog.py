@@ -1,0 +1,72 @@
+"""Host-side divergence watchdog over per-step loss scalars.
+
+The non-finite guard (guard.py) catches outright NaN/inf; this catches the
+slower failure mode where the loss is finite but running away (LR too hot,
+reward hacking blow-up, a corrupted rollout batch). The trainer buffers each
+step's loss as an UN-FETCHED device scalar (no hot-path sync — same
+discipline as the adaptive-KL buffer in trainer/ppo.py) and feeds the host
+values through `observe()` at log boundaries; `True` means "sustained
+divergence — roll back" and trainer/base.py restores the last manifest-valid
+checkpoint, decays the LR by ``train.watchdog_lr_decay``, and resumes.
+
+Multi-host note: the loss is a fully-replicated scalar and the EMA update is
+deterministic, so every process reaches the identical rollback decision
+without any extra collective.
+"""
+
+import math
+
+
+class DivergenceWatchdog:
+    """EMA + threshold breach counter.
+
+    A step *breaches* when its loss is non-finite or exceeds
+    ``ema + threshold * max(|ema|, 1)`` (the additive ``max(|ema|, 1)`` floor
+    keeps the rule meaningful for losses near zero or negative — PPO's total
+    loss routinely goes negative). Breaching steps do NOT update the EMA
+    (otherwise the baseline would chase the divergence it is supposed to
+    flag); ``patience`` consecutive breaches trigger. The first ``warmup``
+    finite observations only build the EMA — no triggering while the
+    baseline is still settling (e.g. the high-loss first steps of a run).
+    """
+
+    def __init__(
+        self,
+        threshold: float,
+        patience: int = 4,
+        ema_alpha: float = 0.9,
+        warmup: int = 5,
+    ):
+        if threshold <= 0:
+            raise ValueError(f"watchdog threshold must be > 0, got {threshold}")
+        self.threshold = float(threshold)
+        self.patience = max(int(patience), 1)
+        self.ema_alpha = float(ema_alpha)
+        self.warmup = max(int(warmup), 0)
+        self.reset()
+
+    def reset(self):
+        """Forget all history — called after a rollback so the restored
+        (pre-divergence) losses rebuild a fresh baseline."""
+        self.ema = None
+        self.breaches = 0
+        self._seen = 0
+
+    def _limit(self) -> float:
+        return self.ema + self.threshold * max(abs(self.ema), 1.0)
+
+    def observe(self, value) -> bool:
+        """Feed one per-step loss; True when divergence is sustained."""
+        v = float(value)
+        warmed = self._seen >= self.warmup
+        if not math.isfinite(v):
+            breach = warmed  # non-finite during warmup: don't trigger, don't learn
+        else:
+            breach = warmed and self.ema is not None and v > self._limit()
+            if not breach:
+                self.ema = v if self.ema is None else (
+                    self.ema_alpha * self.ema + (1.0 - self.ema_alpha) * v
+                )
+                self._seen += 1
+        self.breaches = self.breaches + 1 if breach else 0
+        return self.breaches >= self.patience

@@ -13,6 +13,7 @@ reference delegates to Accelerate/DeepSpeed is explicit here:
 """
 
 import os
+import signal
 import sys
 import time
 import warnings
@@ -37,6 +38,14 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import trainable_mask
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
 from trlx_tpu.parallel.mesh import DATA_AXES, barrier, init_distributed, is_main_process
+from trlx_tpu.resilience import (
+    CheckpointError,
+    DivergenceWatchdog,
+    FaultPlan,
+    TrainingDiverged,
+)
+from trlx_tpu.resilience import checkpoint as ckpt_util
+from trlx_tpu.resilience.faults import poison_nan
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock
 from trlx_tpu.utils.logging import Tracker
@@ -44,12 +53,17 @@ from trlx_tpu.utils.logging import Tracker
 
 class TrainState(struct.PyTreeNode):
     """Donatable training state: params + optimizer state + frozen extras
-    (ref-branch params for PPO, target-Q params for ILQL)."""
+    (ref-branch params for PPO, target-Q params for ILQL). `bad_steps`
+    counts CONSECUTIVE updates skipped by the on-device non-finite guard
+    (trlx_tpu/resilience/guard.py) — on-device so the guard costs no host
+    sync, in the state so it survives checkpoints. Default None keeps
+    hand-built abstract states (tests/test_scale_compile.py) valid."""
 
     step: jnp.ndarray
     params: Any
     opt_state: Any
     extras: Any = None
+    bad_steps: Any = None
 
 
 def lr_schedule(train_cfg):
@@ -128,6 +142,29 @@ class JaxBaseTrainer(BaseRLTrainer):
         state = self.init_state(init_params)
         self.state, self.state_shardings = shard_pytree(state, self.mesh)
 
+        # ---- resilience state (trlx_tpu/resilience/): must exist before
+        # _maybe_resume — load() finalizes pending saves and restores the
+        # resilience host state.
+        self.fault_plan = FaultPlan.from_env_or_config(config.train.fault_plan)
+        self._ckptr = ocp.StandardCheckpointer()
+        self._pending_save = None  # at most one async save in flight
+        self._save_count = 0
+        self._lr_scale = 1.0  # watchdog LR decay multiplier (compounds)
+        self._rollbacks = 0
+        self.skipped_steps = 0  # total guard-skipped updates (host count)
+        self._res_pending = []  # buffered per-step device scalars (no sync)
+        self.last_restore_fallback = False  # load() fell past latest.txt
+        self.watchdog = (
+            DivergenceWatchdog(
+                config.train.watchdog_threshold,
+                patience=config.train.watchdog_patience,
+                ema_alpha=config.train.watchdog_ema_alpha,
+                warmup=config.train.watchdog_warmup,
+            )
+            if config.train.watchdog_threshold > 0
+            else None
+        )
+
         # Resume BEFORE any rollout: PPO's initial experience must come from
         # the restored policy, not the fresh init (stale behavior logprobs
         # would mis-clip the whole first epoch's importance ratios).
@@ -187,8 +224,34 @@ class JaxBaseTrainer(BaseRLTrainer):
         (reference: trlx/model/accelerate_base_model.py:49-64). Masked params
         get NO optimizer moments: layer freezing is also a ZeRO-style memory
         saving here."""
-        optimizer, self.schedule = build_optimizer(self.config.train, self.opt_mask)
+        optimizer, self.schedule = build_optimizer(self._scaled_train_cfg(), self.opt_mask)
         return optimizer
+
+    def _scaled_train_cfg(self):
+        """Train config with the watchdog's LR decay folded into the
+        schedule endpoints (identity when no rollback has fired). getattr:
+        the first build in __init__ runs before the resilience state does."""
+        scale = getattr(self, "_lr_scale", 1.0)
+        if scale == 1.0:
+            return self.config.train
+        from dataclasses import replace
+
+        t = self.config.train
+        return replace(
+            t,
+            learning_rate_init=t.learning_rate_init * scale,
+            learning_rate_target=t.learning_rate_target * scale,
+        )
+
+    def _rebuild_for_lr_scale(self):
+        """Rebuild optimizer/schedule (and the jitted train step, once it
+        exists) after `_lr_scale` changed. The optimizer STATE layout is
+        unchanged — only hyperparameters differ — so the live/restored
+        opt_state remains valid. Recompile cost is paid per rollback event,
+        never on the hot path."""
+        self.optimizer = self._build_optimizer()
+        if getattr(self, "train_step", None) is not None:
+            self.train_step = self.build_train_step()
 
     def build_trainable_mask(self, init_params):
         """Default layer-freezing mask (num_layers_unfrozen); subclasses
@@ -213,6 +276,7 @@ class JaxBaseTrainer(BaseRLTrainer):
             params=init_params,
             opt_state=self.optimizer.init(init_params),
             extras=self.make_extras(init_params),
+            bad_steps=jnp.zeros((), dtype=jnp.int32),
         )
 
     def make_extras(self, init_params):
@@ -553,8 +617,6 @@ class JaxBaseTrainer(BaseRLTrainer):
         # (an any-reduce at each batch boundary, see _preemption_agreed) so
         # every host enters the collective orbax save together — an
         # unsynchronized per-process flag would deadlock a pod.
-        import signal
-
         self._preempted = False
 
         def on_sigterm(signum, frame):
@@ -572,6 +634,10 @@ class JaxBaseTrainer(BaseRLTrainer):
             return self._learn_loop(profiler_tick)
         finally:
             self.end_progress()
+            # An async interval save may still be in flight — its sidecars
+            # (manifest, latest.txt) only land at finalize, so the exit path
+            # must drain it or the checkpoint is invisible to resume.
+            self._finalize_pending_save()
             if self._profiling:
                 jax.profiler.stop_trace()
             if handler_installed:
@@ -614,7 +680,15 @@ class JaxBaseTrainer(BaseRLTrainer):
                 for _ in range(self.n_updates_per_batch):
                     profiler_tick()
                     forward_t0 = time.time()
-                    self.state, stats = self.train_step(self.state, device_batch)
+                    step_batch = device_batch
+                    if self.fault_plan and self.fault_plan.fire(
+                        "nan_grad", self.iter_count + 1
+                    ):
+                        # Injected numeric blow-up: NaN-poison the float
+                        # leaves of THIS step's batch (fault drill for the
+                        # on-device non-finite guard).
+                        step_batch = poison_nan(device_batch)
+                    self.state, stats = self.train_step(self.state, step_batch)
                     self.iter_count += 1
 
                     # Every step gets the DEVICE stats dict (async, no sync):
@@ -624,10 +698,35 @@ class JaxBaseTrainer(BaseRLTrainer):
                     # no longer blinds or rescales the controller).
                     self.post_backward_callback(stats)
 
+                    # Buffer this step's resilience scalars (un-fetched
+                    # device values — the same zero-sync discipline as the
+                    # KL buffer); flushed at log boundaries below.
+                    if self.watchdog is not None or "resilience/bad_steps" in stats:
+                        self._res_pending.append(
+                            (
+                                stats.get("loss"),
+                                stats.get("resilience/nonfinite"),
+                                stats.get("resilience/bad_steps"),
+                            )
+                        )
+                        if len(self._res_pending) >= max(self.config.train.log_interval, 8):
+                            self._flush_resilience()
+
+                    if self.fault_plan and self.fault_plan.fire("sigterm", self.iter_count):
+                        # Synthetic preemption notice (fault drill for the
+                        # SIGTERM save/resume path) — delivered for real so
+                        # the actual signal handler runs.
+                        os.kill(os.getpid(), signal.SIGTERM)
+
                     intervals = self.intervals(self.iter_count)
                     if intervals["do_checkpoint"]:
-                        self.save()
+                        # Interval saves follow train.async_checkpointing:
+                        # async dispatches the orbax write and returns — the
+                        # save overlaps training and only blocks at the next
+                        # save/exit (_finalize_pending_save).
+                        self.save(block=not self.config.train.async_checkpointing)
                     if intervals["do_log"] or intervals["do_eval"]:
+                        self._flush_resilience()
                         # Reading stats forces a device sync — the price of
                         # logging (per-step by default, as in the reference's
                         # accelerator.log, reference:
@@ -687,7 +786,15 @@ class JaxBaseTrainer(BaseRLTrainer):
     def host_state_dict(self) -> dict:
         """Host-side Python state that a true resume must also restore
         (subclasses extend — PPO adds the adaptive KL coefficient)."""
-        return {"rng": [int(x) for x in np.asarray(jax.device_get(self.rng)).reshape(-1)]}
+        self._flush_resilience(allow_rollback=False)  # counters up to date
+        return {
+            "rng": [int(x) for x in np.asarray(jax.device_get(self.rng)).reshape(-1)],
+            "resilience": {
+                "skipped_steps": int(self.skipped_steps),
+                "rollbacks": int(self._rollbacks),
+                "lr_scale": float(self._lr_scale),
+            },
+        }
 
     def load_host_state(self, d: dict):
         """Called during __init__-time resume — subclass state that doesn't
@@ -695,29 +802,174 @@ class JaxBaseTrainer(BaseRLTrainer):
         self.loaded_host_state = d
         if "rng" in d:
             self.rng = jnp.asarray(np.asarray(d["rng"], dtype=np.uint32))
+        res = d.get("resilience", {})
+        if res:
+            self.skipped_steps = int(res.get("skipped_steps", self.skipped_steps))
+            # Monotone merges, NOT plain overwrites: a watchdog rollback
+            # restores an OLDER checkpoint whose host state predates the
+            # rollback itself — taking its (lower) rollback count or (higher)
+            # lr_scale verbatim would reset the safety budget and un-decay
+            # the LR, making a divergence loop unbounded.
+            self._rollbacks = max(self._rollbacks, int(res.get("rollbacks", 0)))
+            scale = min(self._lr_scale, float(res.get("lr_scale", 1.0)))
+            if scale != self._lr_scale:
+                self._lr_scale = scale
+                self._rebuild_for_lr_scale()
 
-    def save(self, directory: Optional[str] = None):
+    # ------------------------------------------------------------ resilience
+
+    def _flush_resilience(self, allow_rollback: bool = True):
+        """Drain the buffered per-step resilience scalars in ONE host sync.
+
+        Per buffered step: count skipped (non-finite) updates, abort after
+        ``train.max_bad_steps`` CONSECUTIVE skips, and feed the loss to the
+        divergence watchdog — which may trigger a checkpoint rollback
+        (suppressed with ``allow_rollback=False`` when called from inside
+        save/host_state_dict, where a rollback would recurse)."""
+        if not self._res_pending:
+            return
+        pending, self._res_pending = self._res_pending, []
+        max_bad = self.config.train.max_bad_steps
+        skips_before = self.skipped_steps
+        for loss, nonfinite, bad in jax.device_get(pending):
+            if nonfinite is not None and float(nonfinite) > 0:
+                self.skipped_steps += 1
+            if bad is not None and max_bad > 0 and int(bad) >= max_bad:
+                raise TrainingDiverged(
+                    f"{int(bad)} consecutive non-finite train steps (>= "
+                    f"train.max_bad_steps={max_bad}) around step "
+                    f"{self.iter_count} — persistent numeric blow-up, not a "
+                    "one-off bad batch. Lower the learning rate, tighten "
+                    "train.grad_clip, or inspect the data; raise "
+                    "train.max_bad_steps only if skips are expected."
+                )
+            if (
+                allow_rollback
+                and self.watchdog is not None
+                and loss is not None
+                and self.watchdog.observe(float(loss))
+            ):
+                # Remaining observations predate the rollback — drop them.
+                self._rollback()
+                return
+        if self.skipped_steps != skips_before and getattr(self, "tracker", None) is not None:
+            self.tracker.log(
+                {"resilience/skipped_steps": float(self.skipped_steps)},
+                step=self.iter_count,
+            )
+
+    def _rollback(self):
+        """Divergence watchdog response: restore the last intact checkpoint,
+        decay the LR, and resume — aborting after ``train.max_rollbacks``."""
+        self._rollbacks += 1
+        t = self.config.train
+        if self._rollbacks > t.max_rollbacks:
+            raise TrainingDiverged(
+                f"divergence watchdog fired after {t.max_rollbacks} rollback(s) "
+                "already spent — training is not recovering. Lower the "
+                "learning rate / tighten train.grad_clip, or raise "
+                "train.max_rollbacks if the loss spikes are believed transient."
+            )
+        self.end_progress()
+        if is_main_process():
+            print(
+                f"[trlx_tpu.resilience] divergence watchdog fired at step "
+                f"{self.iter_count} — rolling back "
+                f"({self._rollbacks}/{t.max_rollbacks})",
+                file=sys.stderr,
+                flush=True,
+            )
+        try:
+            self.load()
+        except CheckpointError as e:
+            raise TrainingDiverged(
+                f"divergence watchdog fired at step {self.iter_count} but no "
+                f"restorable checkpoint exists to roll back to: {e}"
+            ) from e
+        if t.watchdog_lr_decay < 1.0:
+            self._lr_scale *= t.watchdog_lr_decay
+            self._rebuild_for_lr_scale()
+        self.watchdog.reset()
+        self._res_pending = []
+        self.iter_count = int(jax.device_get(self.state.step))
+        if getattr(self, "tracker", None) is not None:
+            self.tracker.log(
+                {
+                    "resilience/rollback_to_step": float(self.iter_count),
+                    "resilience/rollbacks": float(self._rollbacks),
+                    "resilience/lr_scale": float(self._lr_scale),
+                },
+                step=self.iter_count,
+            )
+
+    def save(self, directory: Optional[str] = None, block: bool = True):
         """Orbax sharded checkpoint of the FULL TrainState (params, optimizer
         moments, step, extras) plus host-side state (RNG, KL controller) — a
         true resume point, unlike the reference's save-only
         accelerator.save_state
-        (reference: trlx/model/accelerate_base_model.py:126-128)."""
-        import json
+        (reference: trlx/model/accelerate_base_model.py:126-128).
 
-        save_t0 = time.time()
+        ``block=False`` honors train.async_checkpointing: the orbax write is
+        dispatched and training continues; the sidecars (host state,
+        manifest, latest.txt) land at `_finalize_pending_save` — i.e. at the
+        next save, rollback, load, or learn-loop exit. Crash-consistent by
+        construction: latest.txt is only repointed AFTER the data is fully
+        committed, so a crash mid-async-save leaves the previous checkpoint
+        as the resume point."""
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
+        self._finalize_pending_save()  # at most one save in flight
         name = f"state_{int(jax.device_get(self.state.step))}"
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(directory, name), self.state, force=True)
-        ckptr.wait_until_finished()
-        self.tracker.log({"save_time": time.time() - save_t0}, step=self.iter_count)
+        self._save_count += 1
+        self._pending_save = {
+            "directory": directory,
+            "name": name,
+            "t0": time.time(),
+            "save_index": self._save_count,
+            # Captured NOW — by finalize time the host state (RNG, KL
+            # coefficient) may have advanced past this checkpoint's step.
+            "host_state": self.host_state_dict(),
+        }
+        self._ckptr.save(os.path.join(directory, name), self.state, force=True)
+        if block:
+            self._finalize_pending_save()
+
+    def _finalize_pending_save(self):
+        """Drain the in-flight async save: wait for the orbax commit, then
+        atomically write host state + manifest + latest.txt (in that order —
+        the pointer flips last), apply the retention policy, and fire any
+        ckpt_corrupt fault."""
+        pending, self._pending_save = self._pending_save, None
+        if pending is None:
+            return None
+        directory, name = pending["directory"], pending["name"]
+        self._ckptr.wait_until_finished()
+        if getattr(self, "tracker", None) is not None:
+            self.tracker.log(
+                {"save_time": time.time() - pending["t0"]}, step=self.iter_count
+            )
         if is_main_process():
-            with open(os.path.join(directory, f"{name}.host.json"), "w") as f:
-                json.dump(self.host_state_dict(), f)
+            step = ckpt_util.checkpoint_step(name)
+            ckpt_util.atomic_write_json(
+                os.path.join(directory, f"{name}.host.json"), pending["host_state"]
+            )
+            ckpt_util.write_manifest(directory, name, step if step is not None else 0)
             # basename, not abspath: checkpoint dirs get synced/remounted
-            # between the preempted VM and its replacement.
-            with open(os.path.join(directory, "latest.txt"), "w") as f:
-                f.write(name)
+            # between the preempted VM and its replacement. Written LAST and
+            # atomically — a crash anywhere above leaves the old pointer.
+            ckpt_util.atomic_write_text(os.path.join(directory, "latest.txt"), name)
+            if self.fault_plan and self.fault_plan.fire(
+                "ckpt_corrupt", pending["save_index"]
+            ):
+                rel = ckpt_util.corrupt_checkpoint(directory, name)
+                print(
+                    f"[trlx_tpu.resilience] injected checkpoint corruption: "
+                    f"truncated {name}/{rel}",
+                    file=sys.stderr,
+                )
+            ckpt_util.gc_checkpoints(
+                directory, self.config.train.keep_checkpoints, protect=(name,)
+            )
+        return name
 
     def save_pretrained(self, out_dir: str, family: Optional[str] = None):
         """Export the trained policy trunk as an ordinary HuggingFace
@@ -771,22 +1023,83 @@ class JaxBaseTrainer(BaseRLTrainer):
 
     def load(self, directory: Optional[str] = None):
         """Restore a TrainState + host state saved by `save` (resume support
-        the reference lacks)."""
+        the reference lacks).
+
+        Hardened: candidates are tried newest-first starting from the
+        latest.txt pointer; each is manifest-verified (truncated / corrupted
+        files fail BEFORE the orbax restore) and a failed restore falls back
+        to the previous intact checkpoint. Raises CheckpointError with the
+        full attempt log when nothing is restorable — instead of the raw
+        FileNotFoundError / orbax traceback a missing or half-written
+        checkpoint used to produce."""
         import json
 
+        self._finalize_pending_save()  # a pending async save IS the latest
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
-        with open(os.path.join(directory, "latest.txt")) as f:
-            name = f.read().strip()
-        # Older checkpoints stored an absolute path; fall back to its
-        # basename under the current directory when it moved.
-        path = name if os.path.isabs(name) and os.path.exists(name) else os.path.join(directory, os.path.basename(name))
-        ckptr = ocp.StandardCheckpointer()
-        self.state = ckptr.restore(path, self.state)
-        host_file = f"{path}.host.json"
-        if os.path.exists(host_file):
-            with open(host_file) as f:
-                self.load_host_state(json.load(f))
-        return self.state
+        latest_path = os.path.join(directory, "latest.txt")
+        latest = None
+        if os.path.exists(latest_path):
+            with open(latest_path) as f:
+                latest = f.read().strip() or None
+
+        # Candidate order: the latest pointer first, then every other
+        # state_* directory newest-step-first.
+        candidates = []
+        if latest is not None:
+            candidates.append(latest)
+        for name in ckpt_util.list_checkpoints(directory):
+            if name != os.path.basename(candidates[0] if candidates else ""):
+                candidates.append(name)
+        if not candidates:
+            raise CheckpointError(
+                f"no checkpoint found in {directory}: "
+                + ("latest.txt is empty" if os.path.exists(latest_path) else "latest.txt is missing")
+                + " and no state_* directories exist — nothing to resume from "
+                "(set train.resume_from_checkpoint=False to start fresh, or "
+                "point train.checkpoint_dir at the directory that holds the run)"
+            )
+
+        attempts = []
+        for i, cand in enumerate(candidates):
+            name = os.path.basename(cand)
+            # Older checkpoints stored an absolute path; fall back to its
+            # basename under the current directory when it moved.
+            path = (
+                cand
+                if os.path.isabs(cand) and os.path.exists(cand)
+                else os.path.join(directory, name)
+            )
+            if not os.path.isdir(path):
+                attempts.append(f"{name}: checkpoint directory missing")
+                continue
+            ok, reason = ckpt_util.verify_checkpoint(os.path.dirname(path), name)
+            if not ok:
+                attempts.append(f"{name}: {reason}")
+                continue
+            try:
+                self.state = self._ckptr.restore(path, self.state)
+            except Exception as e:  # noqa: BLE001 — fall back to older checkpoint
+                attempts.append(f"{name}: orbax restore failed ({type(e).__name__}: {e})")
+                continue
+            self.last_restore_fallback = i > 0
+            if i > 0 and is_main_process():
+                print(
+                    f"[trlx_tpu.resilience] latest checkpoint unusable "
+                    f"({'; '.join(attempts)}) — fell back to {name}",
+                    file=sys.stderr,
+                )
+            host_file = f"{path}.host.json"
+            if os.path.exists(host_file):
+                with open(host_file) as f:
+                    self.load_host_state(json.load(f))
+            return self.state
+
+        raise CheckpointError(
+            f"no restorable checkpoint in {directory} — every candidate "
+            f"failed verification or restore: {'; '.join(attempts)}. "
+            "If the data is gone, set train.resume_from_checkpoint=False to "
+            "start fresh."
+        )
 
     # ------------------------------------------------------- BaseRL protocol
 

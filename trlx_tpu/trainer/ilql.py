@@ -26,6 +26,7 @@ from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.ilql_loss import ilql_loss
 from trlx_tpu.ops.modeling import topk_mask
 from trlx_tpu.ops.sampling import NEG_INF, GenerateConfig
+from trlx_tpu.resilience.guard import guarded_update
 from trlx_tpu.trainer import register_model
 from trlx_tpu.trainer.base import JaxBaseTrainer
 
@@ -203,15 +204,28 @@ class ILQLTrainer(JaxBaseTrainer):
 
         def train_step(state, batch: ILQLBatch):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, state.extras, batch)
-            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
             stats = dict(stats)
+            if self.config.train.nonfinite_guard:
+                bad0 = state.bad_steps
+                if bad0 is None:
+                    bad0 = jnp.zeros((), dtype=jnp.int32)
+                params, opt_state, bad, finite = guarded_update(
+                    optimizer, grads, loss, state.params, state.opt_state, bad0
+                )
+                stats["resilience/nonfinite"] = 1.0 - finite.astype(jnp.float32)
+                stats["resilience/bad_steps"] = bad.astype(jnp.float32)
+            else:
+                updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
+                bad = state.bad_steps
             stats["grad_norm"] = optax.global_norm(grads)
             if self.config.train.watch_interval:
                 for group, sub in grads.items():
                     stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
             stats["learning_rate"] = schedule(state.step)
-            return state.replace(step=state.step + 1, params=params, opt_state=opt_state), stats
+            return state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state, bad_steps=bad
+            ), stats
 
         return jax.jit(train_step, donate_argnums=(0,))
 

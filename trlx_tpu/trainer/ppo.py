@@ -23,6 +23,7 @@ from trlx_tpu.ops.modeling import logprobs_from_logits
 from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
 from trlx_tpu.ops.sampling import GenerateConfig
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.resilience.guard import guarded_update
 from trlx_tpu.trainer import register_model
 from trlx_tpu.trainer.base import JaxBaseTrainer
 
@@ -416,7 +417,13 @@ class PPOTrainer(JaxBaseTrainer):
     def load_host_state(self, d: dict):
         super().load_host_state(d)
         if "kl_coef" in d and hasattr(self, "kl_ctl"):
-            self.kl_ctl.value = float(d["kl_coef"])
+            import math
+
+            v = float(d["kl_coef"])
+            # A checkpoint written by an older build could carry a poisoned
+            # coefficient — restoring NaN would NaN every KL-penalty reward.
+            if math.isfinite(v):
+                self.kl_ctl.value = v
 
     # ------------------------------------------------------------- callbacks
 
@@ -442,9 +449,19 @@ class PPOTrainer(JaxBaseTrainer):
     def _flush_kl_updates(self):
         if not self._kl_pending:
             return
+        import math
+
         pending, self._kl_pending = self._kl_pending, []
         for v in jax.device_get(pending):
-            self.kl_ctl.update(float(v), self.config.train.batch_size)
+            v = float(v)
+            if not math.isfinite(v):
+                # A guard-skipped (non-finite) step's stats are garbage by
+                # construction — feeding its NaN mean_kl to the controller
+                # would poison kl_ctl.value and, through the KL-penalty
+                # rewards, every subsequent rollout (and the saved host
+                # state). Skip it; the step's update was skipped too.
+                continue
+            self.kl_ctl.update(v, self.config.train.batch_size)
 
     def host_state_dict(self) -> dict:
         self._flush_kl_updates()
@@ -507,9 +524,23 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
 
     def train_step(state, batch: PPORLBatch):
         (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
         stats = dict(stats)
+        if config.train.nonfinite_guard:
+            # Abstract states built before the bad_steps field existed
+            # (tests/test_scale_compile.py hand-constructs them) default it
+            # to None — materialize the counter in-trace.
+            bad0 = state.bad_steps
+            if bad0 is None:
+                bad0 = jnp.zeros((), dtype=jnp.int32)
+            params, opt_state, bad, finite = guarded_update(
+                optimizer, grads, loss, state.params, state.opt_state, bad0
+            )
+            stats["resilience/nonfinite"] = 1.0 - finite.astype(jnp.float32)
+            stats["resilience/bad_steps"] = bad.astype(jnp.float32)
+        else:
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            bad = state.bad_steps
         stats["grad_norm"] = optax.global_norm(grads)
         if config.train.watch_interval:
             # per-group grad norms for the wandb.watch-equivalent; device
@@ -517,7 +548,9 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
             for group, sub in grads.items():
                 stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
         stats["learning_rate"] = schedule(state.step)
-        new_state = state.replace(step=state.step + 1, params=params, opt_state=opt_state)
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state, bad_steps=bad
+        )
         return new_state, stats
 
     return jax.jit(train_step, donate_argnums=(0,))
