@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 quality style bench bench-reference acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference acceptance-network
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -32,6 +32,15 @@ test-shard2:
 test-shard3:
 	$(TEST_ENV) python -m pytest -q -m slow \
 	    tests/test_mesh.py tests/test_multihost.py tests/test_scale_compile.py
+
+# 2-process distributed drills: boundary-helper/train-resume semantics plus
+# the fault drills (host_hang → CollectiveTimeout, coordinated preemption
+# save/resume, host_desync → fingerprint guard). Non-blocking CI job —
+# jax.distributed on shared runners can be flaky; see RUNBOOK §3b for the
+# local drill command and the triage table.
+test-multihost:
+	$(TEST_ENV) python -m pytest -q -m slow \
+	    tests/test_multihost.py tests/test_distributed_resilience.py
 
 quality:
 	ruff check trlx_tpu/ tests/ examples/ bench.py

@@ -51,14 +51,16 @@ def _trajectories(basetemp):
         key = TESTS[test][0]
         hist = key.split(":", 1)[1] if key.startswith("histogram:") else None
         vals = []
-        with open(path) as f:
-            for line in f:
-                rec = json.loads(line)
-                if hist is not None:
-                    if rec.get("histogram") == hist:
-                        vals.append(round(float(rec["mean"]), 4))
-                elif key in rec:
-                    vals.append(round(float(rec[key]), 4))
+        # Tolerant reader: a run killed mid-append (preemption, host_kill
+        # drill) leaves a torn final line; the completed records still count.
+        from trlx_tpu.utils.logging import read_jsonl
+
+        for rec in read_jsonl(path):
+            if hist is not None:
+                if rec.get("histogram") == hist:
+                    vals.append(round(float(rec["mean"]), 4))
+            elif key in rec:
+                vals.append(round(float(rec[key]), 4))
         out[test] = vals
     return out
 

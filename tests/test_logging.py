@@ -101,6 +101,41 @@ def test_wandb_branch_with_stub(tmp_path, monkeypatch):
     assert (tmp_path / "metrics.jsonl").exists()
 
 
+def test_records_land_unbuffered_line_atomic(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRLX_TPU_DISABLE_TRACKER", raising=False)
+    monkeypatch.delenv("debug", raising=False)
+    monkeypatch.setattr(tlog, "_HAS_WANDB", False)
+    tracker = Tracker("proj", log_dir=str(tmp_path))
+    tracker.log({"loss": 1.0}, step=1)
+    # No flush/close: unbuffered O_APPEND means the record already landed as
+    # ONE complete newline-terminated write — a kill between log() calls
+    # (preemption, host_kill drill) can never leave a torn line.
+    data = (tmp_path / "metrics.jsonl").read_bytes()
+    assert data.endswith(b"\n")
+    assert json.loads(data.splitlines()[-1])["loss"] == 1.0
+    tracker.finish()
+
+
+def test_read_jsonl_tolerates_torn_final_line_only(tmp_path):
+    p = str(tmp_path / "metrics.jsonl")
+    with open(p, "wb") as f:
+        f.write(b'{"step": 1}\n{"step": 2}\n{"step": 3, "lo')  # killed mid-append
+    with pytest.warns(UserWarning, match="torn final record"):
+        recs = tlog.read_jsonl(p)
+    assert recs == [{"step": 1}, {"step": 2}]
+
+    # a malformed line in the MIDDLE is real corruption and still raises
+    with open(p, "wb") as f:
+        f.write(b'{"step": 1}\n{"bad\n{"step": 2}\n')
+    with pytest.raises(json.JSONDecodeError):
+        tlog.read_jsonl(p)
+
+    # intact files round-trip without warnings
+    with open(p, "wb") as f:
+        f.write(b'{"step": 1}\n{"step": 2}\n')
+    assert tlog.read_jsonl(p) == [{"step": 1}, {"step": 2}]
+
+
 def test_disable_via_explicit_env(tmp_path, monkeypatch):
     monkeypatch.setenv("TRLX_TPU_DISABLE_TRACKER", "1")
     tracker = Tracker("proj", log_dir=str(tmp_path))

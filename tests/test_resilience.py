@@ -23,14 +23,24 @@ import trlx_tpu  # noqa: E402
 from randomwalks import base_config, generate_random_walks  # noqa: E402
 from trlx_tpu.resilience import (  # noqa: E402
     CheckpointError,
+    CollectiveTimeout,
     DivergenceWatchdog,
     FaultInjected,
     FaultPlan,
+    Heartbeat,
+    HostDesync,
     TrainingDiverged,
     all_finite,
     call_with_retries,
+    collective_guard,
+    compare_fingerprints,
     guarded_update,
+    host_fingerprint,
+    perturb_local_replicas,
     poison_nan,
+    read_heartbeats,
+    stall_report,
+    verify_fingerprints,
 )
 from trlx_tpu.resilience import checkpoint as ckpt_util  # noqa: E402
 from trlx_tpu.trainer.base import lr_schedule  # noqa: E402
@@ -228,6 +238,14 @@ def test_manifest_verifies_and_catches_truncation(tmp_path):
     assert not ok
 
 
+def test_multihost_fault_kinds_parse_and_fire_once():
+    plan = FaultPlan.parse("host_hang@1,host_kill@2,slow_host@3,host_desync@4")
+    for kind, tick in (("host_hang", 1), ("host_kill", 2), ("slow_host", 3), ("host_desync", 4)):
+        assert not plan.fire(kind, tick + 10)
+        assert plan.fire(kind, tick)
+        assert not plan.fire(kind, tick)  # exactly once
+
+
 def test_gc_keeps_newest_and_protected(tmp_path):
     d = str(tmp_path)
     for step in (1, 2, 3, 4):
@@ -241,6 +259,36 @@ def test_gc_keeps_newest_and_protected(tmp_path):
     # sidecars of the removed checkpoint are gone too
     assert not os.path.exists(os.path.join(d, "state_2.host.json"))
     assert not os.path.exists(ckpt_util.manifest_path(d, "state_2"))
+
+
+def test_gc_never_deletes_latest_pointer_or_in_use(tmp_path):
+    """Satellite regression: retention GC must not delete the checkpoint
+    latest.txt references (it can be OLDER than `keep` newer directories
+    after a watchdog rollback), nor one a concurrent reader marked in-use."""
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4, 5):
+        _fake_checkpoint(d, step)
+    ckpt_util.atomic_write_text(os.path.join(d, "latest.txt"), "state_1")
+
+    with ckpt_util.mark_in_use(d, "state_2"):
+        # keep=2 would normally drop state_3/2/1 — but 1 is the latest
+        # pointer and 2 is mid-restore.
+        assert ckpt_util.gc_checkpoints(d, keep=2) == ["state_3"]
+        assert sorted(ckpt_util.list_checkpoints(d)) == [
+            "state_1", "state_2", "state_4", "state_5",
+        ]
+    # marker gone on clean exit → the next GC may collect state_2, but the
+    # latest pointer stays protected forever
+    assert ckpt_util.gc_checkpoints(d, keep=2) == ["state_2"]
+    assert ckpt_util.latest_pointer(d) == "state_1"
+    assert "state_1" in ckpt_util.list_checkpoints(d)
+
+    # a stale marker (killed reader) ages out instead of pinning forever
+    marker = os.path.join(d, "state_4.inuse.99999")
+    ckpt_util.atomic_write_json(marker, {})
+    old = time.time() - 2 * ckpt_util.IN_USE_MAX_AGE
+    os.utime(marker, (old, old))
+    assert "state_4" not in ckpt_util._names_in_use(d)
 
 
 # ------------------------------------------------------------- trainer level
@@ -366,6 +414,187 @@ def test_watchdog_rollback_restores_and_decays_lr(task, tmp_path):
     trainer._rollbacks = trainer.config.train.max_rollbacks
     with pytest.raises(TrainingDiverged, match="max_rollbacks"):
         trainer._rollback()
+
+
+def test_watchdog_multiple_rollbacks_compound_lr_and_abort(task, tmp_path):
+    """Satellite: across SEVERAL rollbacks the LR decay compounds
+    (0.5 → 0.25) into the live schedule, and the max_rollbacks abort is
+    deterministic — exactly at budget + 1, with the budget not reset by the
+    restores in between."""
+    d = str(tmp_path / "ck")
+    trainer = make_trainer(
+        task,
+        d,
+        watchdog_threshold=0.5,
+        watchdog_patience=2,
+        watchdog_warmup=1,
+        watchdog_lr_decay=0.5,
+        max_rollbacks=2,
+    )
+    trainer.save(d)  # the good state at step 0
+    base_lr = float(lr_schedule(trainer.config.train)(10))  # past warmup
+    assert base_lr > 0
+
+    def diverge():
+        trainer.state = trainer.state.replace(step=trainer.state.step + 5)
+        trainer.iter_count = int(jax.device_get(trainer.state.step))
+        losses = [1.0, 1.0, 100.0, 100.0]  # settle, then sustained spike
+        trainer._res_pending = [(jnp.asarray(v), None, None) for v in losses]
+        trainer._flush_resilience()
+
+    diverge()  # rollback 1
+    assert trainer._rollbacks == 1
+    assert trainer._lr_scale == pytest.approx(0.5)
+    assert float(trainer.schedule(10)) == pytest.approx(0.5 * base_lr)
+
+    diverge()  # rollback 2: the decay COMPOUNDS, the restore resets state
+    assert trainer._rollbacks == 2
+    assert trainer._lr_scale == pytest.approx(0.25)
+    assert float(trainer.schedule(10)) == pytest.approx(0.25 * base_lr)
+    assert int(jax.device_get(trainer.state.step)) == 0
+    assert trainer.iter_count == 0
+
+    # rollback 3 exceeds max_rollbacks=2 → deterministic abort, budget kept
+    with pytest.raises(TrainingDiverged, match="max_rollbacks"):
+        diverge()
+    assert trainer._rollbacks == 3
+    assert trainer._lr_scale == pytest.approx(0.25)  # no decay past the abort
+
+
+# ---------------------------------------------------- distributed resilience
+
+
+def test_heartbeat_write_read_and_stall_report(tmp_path):
+    d = str(tmp_path / "hb")
+    hb0 = Heartbeat(d, interval=0.0, process_index=0).start()  # no thread
+    hb0.beat(step=7, phase="collective:allgather_host")
+    hb0._write()
+    hb1 = Heartbeat(d, interval=0.0, process_index=1).start()
+    hb1.beat(step=3, phase="train")
+    hb1.progress_t = time.time() - 100.0  # frozen progress stamp
+    hb1._write()
+
+    beats = read_heartbeats(d)
+    assert set(beats) == {0, 1}
+    assert beats[0]["step"] == 7 and beats[1]["phase"] == "train"
+
+    # host 0 is INSIDE the collective (a waiter); host 1 never arrived and
+    # has the oldest progress → the report names host 1
+    report = stall_report(d, "allgather_host")
+    assert "slowest host: host 1" in report
+    assert "host 0" in report  # per-host summary included
+
+    # a torn heartbeat file is skipped, not fatal
+    with open(os.path.join(d, "host_2.json"), "w") as f:
+        f.write('{"process": 2, "ste')
+    assert set(read_heartbeats(d)) == {0, 1}
+
+    # empty directory → actionable fallback text, no crash
+    assert "heartbeat" in stall_report(str(tmp_path / "none"), "barrier")
+
+
+def test_heartbeat_thread_advances_written_t(tmp_path):
+    hb = Heartbeat(str(tmp_path), interval=0.05, process_index=0).start()
+    try:
+        first = read_heartbeats(str(tmp_path))[0]["written_t"]
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            rec = read_heartbeats(str(tmp_path)).get(0)
+            if rec and rec["written_t"] > first:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("heartbeat thread never flushed a newer written_t")
+        # written_t advanced while progress_t stayed put: the
+        # alive-but-stuck signature the stall report keys on
+        assert rec["progress_t"] == pytest.approx(hb.progress_t)
+    finally:
+        hb.stop()
+
+
+def test_collective_guard_fires_on_slow_body_only():
+    fired = []
+    with collective_guard("drill", deadline=0.15, on_timeout=fired.append):
+        time.sleep(0.5)
+    assert len(fired) == 1
+    exc = fired[0]
+    assert isinstance(exc, CollectiveTimeout)
+    assert "'drill'" in str(exc) and "collective_deadline" in str(exc)
+
+    # fast body: the timer is cancelled, nothing fires afterwards
+    fired2 = []
+    with collective_guard("drill", deadline=0.1, on_timeout=fired2.append):
+        pass
+    time.sleep(0.3)
+    assert not fired2
+
+    # deadline 0 disarms the guard entirely (the default path)
+    with collective_guard("drill", deadline=0.0, on_timeout=fired2.append):
+        time.sleep(0.05)
+    assert not fired2
+
+
+def test_collective_guard_uses_global_config_and_heartbeat(tmp_path):
+    from trlx_tpu.resilience import distributed as dist_res
+
+    hb = Heartbeat(str(tmp_path), interval=0.0, process_index=1).start()
+    hb.beat(step=9, phase="train")
+    hb.progress_t = time.time() - 50.0
+    hb._write()
+    fired = []
+    dist_res.configure(
+        deadline=0.1,
+        heartbeat=hb,
+        step_provider=lambda: 42,
+        on_timeout=fired.append,
+    )
+    try:
+        with collective_guard("barrier:init"):
+            time.sleep(0.4)
+    finally:
+        dist_res.configure()  # disarm — never leak into other tests
+    assert len(fired) == 1
+    msg = str(fired[0])
+    assert "at step 42" in msg
+    assert "slowest host: host 1" in msg  # stall report rode along
+
+
+def test_fingerprint_compare_and_perturb():
+    params = {
+        "ln": {"scale": jnp.ones((8,), jnp.float32)},
+        "w": jnp.arange(4, dtype=jnp.float32),
+    }
+    fp = host_fingerprint(3, params, rng=jax.random.PRNGKey(0))
+    assert fp.shape == (3,) and fp.dtype == np.int64
+    assert int(fp[0]) == 3
+    # deterministic: same state → same fingerprint
+    np.testing.assert_array_equal(fp, host_fingerprint(3, params, rng=jax.random.PRNGKey(0)))
+    # a different rng changes only the rng component
+    fp_rng = host_fingerprint(3, params, rng=jax.random.PRNGKey(1))
+    assert int(fp_rng[1]) == int(fp[1]) and int(fp_rng[2]) != int(fp[2])
+
+    compare_fingerprints(np.stack([fp, fp]))  # agreement → no raise
+    verify_fingerprints(fp)  # single process → trivially consistent
+
+    bad = fp.copy()
+    bad[1] ^= 1
+    with pytest.raises(HostDesync, match=r"host 1.*param replica crc32"):
+        compare_fingerprints(np.stack([fp, bad]))
+    worse = fp.copy()
+    worse[0] += 2
+    with pytest.raises(HostDesync, match=r"host 2.*step counter"):
+        compare_fingerprints(np.stack([fp, fp, worse]))
+
+    # the drill's perturbation changes exactly the param component
+    perturbed = perturb_local_replicas(params, scale=1e-3)
+    fp_pert = host_fingerprint(3, perturbed, rng=jax.random.PRNGKey(0))
+    assert int(fp_pert[1]) != int(fp[1])
+    assert int(fp_pert[0]) == int(fp[0]) and int(fp_pert[2]) == int(fp[2])
+    # structure and shapes untouched; non-target leaves bitwise identical
+    np.testing.assert_array_equal(
+        np.asarray(perturbed["w"]), np.asarray(params["w"])
+    )
+    assert perturbed["ln"]["scale"].shape == (8,)
 
 
 def test_reward_fn_faults_are_retried(task, tmp_path):

@@ -164,6 +164,27 @@ class TrainConfig:
     # env var overrides this field. Empty = no faults.
     fault_plan: str = ""
 
+    # --- distributed resilience (trlx_tpu/resilience/distributed.py) ---
+    # Write <checkpoint_dir>/heartbeats/host_<idx>.json every N seconds
+    # (last step, phase, progress timestamp) — the data the CollectiveTimeout
+    # diagnostic uses to name the slowest host. 0 = off.
+    heartbeat_interval: float = 0.0
+    # Abort (exit code 117, CollectiveTimeout diagnostic) when any blocking
+    # host collective (allgather_host / to_local_host / barrier) outlives
+    # this many seconds — a dead or wedged peer must fail the fleet fast,
+    # not deadlock it. Set comfortably above the slowest legitimate
+    # collective (first-call compilation included). 0 = no deadline.
+    collective_deadline: float = 0.0
+    # Cross-host consistency guard: every N train steps, allgather+compare a
+    # [step, replicated-param crc32, rng crc32] fingerprint and raise
+    # HostDesync naming the diverged host. 0 = off.
+    desync_check_interval: int = 0
+    # Also check the SIGTERM save-and-exit agreement every N train steps
+    # (0 = batch boundaries only). Step-boundary observation tightens the
+    # window between a preemption notice and the coordinated save at the
+    # cost of one tiny allgather per N steps.
+    preempt_check_interval: int = 0
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         cfg = dict(config)

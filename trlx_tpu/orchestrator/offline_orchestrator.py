@@ -21,6 +21,27 @@ class OfflineOrchestrator(Orchestrator):
     def make_experience(self, samples, rewards):
         """(reference: trlx/orchestrator/offline_orchestrator.py:17-74)"""
         model = self.model
+        import jax
+
+        if jax.process_count() > 1:
+            # Per-host sample counts feed per-host dataloader lengths; a
+            # mismatch would have hosts iterate different batch counts and
+            # deadlock in the first train collective. Fail loudly up front,
+            # coordinated (every host sees the same gathered counts and
+            # raises the same error).
+            from trlx_tpu.parallel.mesh import allgather_host
+            from trlx_tpu.resilience.distributed import HostDesync
+
+            counts = allgather_host(
+                np.asarray([len(samples)], dtype=np.int32)
+            ).reshape(-1)
+            if len(set(int(c) for c in counts)) != 1:
+                raise HostDesync(
+                    f"offline sample count differs across hosts: "
+                    f"{counts.tolist()} (host ids are the list indices) — "
+                    "every host must feed the same number of samples to "
+                    "make_experience"
+                )
         if model.tokenizer is not None:
             input_ids = model.tokenize_ilql(samples)
         else:

@@ -121,7 +121,14 @@ class PPOOrchestrator(Orchestrator):
         t = time.time()
         pending = self._generate_next_chunk()
         gen_s += time.time() - t
+        heartbeat = getattr(self.rl_model, "heartbeat", None)
         while True:
+            if heartbeat is not None:
+                # Rollout progress stamp: without it, a long experience phase
+                # looks identical to a wedged host in the stall report — the
+                # phase tag tells the CollectiveTimeout diagnostic this host
+                # was generating, not stuck.
+                heartbeat.beat(step=iter_count, phase="rollout")
             tokens, mask, P, gen_aux = pending
             # Rows THIS process will store (num_rollouts is per-process, the
             # reference's per-rank semantics). Static shape — no device sync.

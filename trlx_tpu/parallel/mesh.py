@@ -148,6 +148,14 @@ def to_local_host(tree, mesh: Optional[Mesh] = None, batch_axes=DATA_AXES):
             multihost_utils.global_array_to_host_local_array(x, m, spec)
         )
 
+    if jax.process_count() > 1:
+        # Reading a global array blocks until every host's shards exist — a
+        # dead peer would hang this forever; the guard converts that into a
+        # deadline'd CollectiveTimeout abort (resilience/distributed.py).
+        from trlx_tpu.resilience.distributed import collective_guard
+
+        with collective_guard("to_local_host"):
+            return jax.tree_util.tree_map(pull, tree)
     return jax.tree_util.tree_map(pull, tree)
 
 
@@ -163,20 +171,29 @@ def allgather_host(tree):
         return jax.tree_util.tree_map(np.asarray, tree)
     from jax.experimental import multihost_utils
 
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True)),
-        tree,
-    )
+    from trlx_tpu.resilience.distributed import collective_guard
+
+    # Guarded: an allgather with a dead/wedged peer never completes — abort
+    # with CollectiveTimeout after train.collective_deadline instead.
+    with collective_guard("allgather_host"):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True)),
+            tree,
+        )
 
 
-def barrier():
+def barrier(name: str = "trlx_tpu_barrier"):
     """Cross-host barrier ≈ the reference's torch.distributed.barrier
     (reference: trlx/model/accelerate_base_model.py:33-34). A tiny psum forces
-    all hosts/devices to synchronize."""
+    all hosts/devices to synchronize. Guarded by the collective deadline —
+    a barrier whose peer died aborts with CollectiveTimeout, not a hang."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("trlx_tpu_barrier")
+        from trlx_tpu.resilience.distributed import collective_guard
+
+        with collective_guard(f"barrier:{name}"):
+            multihost_utils.sync_global_devices(name)
 
 
 def is_main_process() -> bool:

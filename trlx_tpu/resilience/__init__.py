@@ -26,6 +26,16 @@ the PPO orchestrator:
    timeout + bounded retry in the orchestrator, `retry.py`), truncates
    checkpoint files, and delivers synthetic SIGTERM — the harness that makes
    pillars 1-3 verifiable on CPU (tests/test_resilience.py).
+5. **Distributed resilience** (`distributed.py`) — per-host heartbeat files,
+   a deadline guard around every blocking host collective (a dead peer
+   aborts the fleet with ``CollectiveTimeout`` + a slowest-host diagnostic
+   instead of hanging forever), cross-host consistency fingerprints
+   (``HostDesync`` names the diverged host), preemption-coordinated
+   checkpointing (all hosts save the same step; rank 0 flips ``latest.txt``
+   only after an all-hosts-done barrier), and the multi-host fault kinds
+   (``host_hang`` / ``host_kill`` / ``slow_host`` / ``host_desync``) that
+   make it drillable with 2 CPU processes
+   (tests/test_distributed_resilience.py).
 """
 
 
@@ -45,6 +55,19 @@ from trlx_tpu.resilience.checkpoint import (  # noqa: E402
     verify_checkpoint,
     write_manifest,
 )
+from trlx_tpu.resilience.distributed import (  # noqa: E402
+    EXIT_COLLECTIVE_TIMEOUT,
+    CollectiveTimeout,
+    Heartbeat,
+    HostDesync,
+    collective_guard,
+    compare_fingerprints,
+    host_fingerprint,
+    perturb_local_replicas,
+    read_heartbeats,
+    stall_report,
+    verify_fingerprints,
+)
 from trlx_tpu.resilience.faults import FaultInjected, FaultPlan, poison_nan  # noqa: E402
 from trlx_tpu.resilience.guard import all_finite, guarded_update  # noqa: E402
 from trlx_tpu.resilience.retry import call_with_retries  # noqa: E402
@@ -53,6 +76,17 @@ from trlx_tpu.resilience.watchdog import DivergenceWatchdog  # noqa: E402
 __all__ = [
     "TrainingDiverged",
     "CheckpointError",
+    "CollectiveTimeout",
+    "HostDesync",
+    "Heartbeat",
+    "EXIT_COLLECTIVE_TIMEOUT",
+    "collective_guard",
+    "compare_fingerprints",
+    "host_fingerprint",
+    "perturb_local_replicas",
+    "read_heartbeats",
+    "stall_report",
+    "verify_fingerprints",
     "FaultInjected",
     "FaultPlan",
     "DivergenceWatchdog",

@@ -14,10 +14,12 @@ truncated orbax shard must never brick the resume. Invariants enforced here:
   ``state_*`` directories (the one ``latest.txt`` points at is always kept).
 """
 
+import contextlib
 import json
 import os
 import re
 import shutil
+import time
 import zlib
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -151,21 +153,87 @@ def list_checkpoints(directory: str) -> List[str]:
 
 def _remove_checkpoint(directory: str, name: str):
     shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
-    for sidecar in (f"{name}.host.json", f"{name}.manifest.json"):
+    for entry in os.listdir(directory) if os.path.isdir(directory) else ():
+        if entry in (f"{name}.host.json", f"{name}.manifest.json") or entry.startswith(
+            f"{name}.inuse."
+        ):
+            try:
+                os.remove(os.path.join(directory, entry))
+            except FileNotFoundError:
+                pass
+
+
+# How long a reader's .inuse marker shields its checkpoint from GC. Markers
+# are removed on clean exit; the age cap keeps one killed reader (host_kill,
+# OOM) from pinning a checkpoint forever.
+IN_USE_MAX_AGE = 3600.0
+
+
+@contextlib.contextmanager
+def mark_in_use(directory: str, name: str):
+    """Shield `name` from retention GC while a resume verifies/restores it.
+
+    A concurrent writer (another host's `_finalize_pending_save`, or this
+    process's own post-rollback save) must not delete the checkpoint a
+    reader is mid-restore on — the reader would fall over on a file that
+    verified moments earlier. File-based so it works ACROSS processes on the
+    shared checkpoint filesystem."""
+    marker = os.path.join(directory, f"{name}.inuse.{os.getpid()}")
+    try:
+        atomic_write_json(marker, {"pid": os.getpid(), "t": time.time()})
+    except OSError:
+        marker = None  # read-only fs: fall back to unprotected (old behavior)
+    try:
+        yield
+    finally:
+        if marker is not None:
+            try:
+                os.remove(marker)
+            except FileNotFoundError:
+                pass
+
+
+def _names_in_use(directory: str) -> set:
+    names = set()
+    now = time.time()
+    for entry in os.listdir(directory) if os.path.isdir(directory) else ():
+        m = re.match(r"^(state_\d+)\.inuse\.\d+$", entry)
+        if not m:
+            continue
         try:
-            os.remove(os.path.join(directory, sidecar))
-        except FileNotFoundError:
-            pass
+            if now - os.path.getmtime(os.path.join(directory, entry)) <= IN_USE_MAX_AGE:
+                names.add(m.group(1))
+        except OSError:
+            continue
+    return names
+
+
+def latest_pointer(directory: str) -> Optional[str]:
+    """The checkpoint name ``latest.txt`` currently points at, or None."""
+    try:
+        with open(os.path.join(directory, "latest.txt")) as f:
+            content = f.read().strip()
+        return os.path.basename(content) if content else None
+    except OSError:
+        return None
 
 
 def gc_checkpoints(directory: str, keep: int, protect: Iterable[str] = ()) -> List[str]:
     """Delete all but the `keep` newest checkpoints (plus `protect`d names).
 
-    ``keep <= 0`` disables GC entirely (the default — retention is opt-in).
-    Returns the removed names."""
+    Never removed, regardless of age: the checkpoint ``latest.txt`` points
+    at (the fleet's agreed resume point — after a watchdog rollback it can
+    be OLDER than `keep` newer-step directories), and any checkpoint with a
+    fresh ``.inuse`` marker (a concurrent resume is reading it,
+    `mark_in_use`). ``keep <= 0`` disables GC entirely (the default —
+    retention is opt-in). Returns the removed names."""
     if keep <= 0:
         return []
     protected = {os.path.basename(p) for p in protect}
+    latest = latest_pointer(directory)
+    if latest is not None:
+        protected.add(latest)
+    protected |= _names_in_use(directory)
     removed = []
     for name in list_checkpoints(directory)[keep:]:
         if name in protected:

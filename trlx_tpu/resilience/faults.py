@@ -21,6 +21,23 @@ What "tick" means is defined by the injection site:
                        → exercises manifest verification + restore fallback;
 - ``sigterm@N``      — SIGTERM is delivered to this process after step N →
                        exercises the preemption save/resume path.
+
+Multi-host kinds (fired per PROCESS — a 2-process drill sets a different
+``TRLX_TPU_FAULTS`` on each worker; tests/test_distributed_resilience.py):
+
+- ``host_hang@N``    — this process sleeps ``TRLX_TPU_HANG_SECONDS``
+                       (default 3600) after step N → its peers block in the
+                       next collective and the hang guard aborts the fleet
+                       with ``CollectiveTimeout``;
+- ``host_kill@N``    — this process dies abruptly (``os._exit(1)``, no
+                       cleanup) after step N → peer timeout + torn-file
+                       tolerance on resume;
+- ``slow_host@N``    — this process stalls ``TRLX_TPU_SLOW_SECONDS``
+                       (default 2) after step N → straggler visible in the
+                       heartbeat files without tripping the deadline;
+- ``host_desync@N``  — this process's local copy of a replicated param leaf
+                       is skewed after step N → exercises the cross-host
+                       consistency guard (``HostDesync``).
 """
 
 import os
@@ -31,7 +48,17 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-KINDS = ("nan_grad", "reward_exc", "reward_hang", "ckpt_corrupt", "sigterm")
+KINDS = (
+    "nan_grad",
+    "reward_exc",
+    "reward_hang",
+    "ckpt_corrupt",
+    "sigterm",
+    "host_hang",
+    "host_kill",
+    "slow_host",
+    "host_desync",
+)
 
 _ENTRY_RE = re.compile(r"^([a-z_]+)@(\d+)$")
 

@@ -45,6 +45,7 @@ from trlx_tpu.resilience import (
     TrainingDiverged,
 )
 from trlx_tpu.resilience import checkpoint as ckpt_util
+from trlx_tpu.resilience import distributed as dist_res
 from trlx_tpu.resilience.faults import poison_nan
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock
@@ -118,6 +119,23 @@ class JaxBaseTrainer(BaseRLTrainer):
         init_distributed()
         self.mesh = make_mesh(config.train.mesh, devices=kwargs.pop("mesh_devices", None))
         set_mesh(self.mesh)
+
+        # Distributed resilience (trlx_tpu/resilience/distributed.py) is
+        # armed BEFORE the first barrier so even the init collectives are
+        # deadline-guarded: a host that dies during bootstrap aborts the
+        # fleet with a CollectiveTimeout diagnostic instead of wedging it.
+        self.heartbeat = None
+        if config.train.heartbeat_interval > 0:
+            self.heartbeat = dist_res.Heartbeat(
+                os.path.join(os.path.abspath(config.train.checkpoint_dir), "heartbeats"),
+                config.train.heartbeat_interval,
+            ).start()
+        dist_res.configure(
+            deadline=config.train.collective_deadline,
+            heartbeat=self.heartbeat,
+            step_provider=lambda: getattr(self, "iter_count", 0),
+        )
+
         barrier()  # ≈ reference's init barrier (trlx/model/accelerate_base_model.py:33-34)
 
         # Fail misconfigured batch/mesh combinations HERE — before the
@@ -690,6 +708,13 @@ class JaxBaseTrainer(BaseRLTrainer):
                         step_batch = poison_nan(device_batch)
                     self.state, stats = self.train_step(self.state, step_batch)
                     self.iter_count += 1
+                    if self.heartbeat is not None:
+                        # Progress stamp (cheap attribute stores; the
+                        # heartbeat thread does the file I/O) — a host whose
+                        # stamp freezes here is the one the CollectiveTimeout
+                        # diagnostic will name.
+                        self.heartbeat.beat(step=self.iter_count, phase="train")
+                    self._fire_host_faults()
 
                     # Every step gets the DEVICE stats dict (async, no sync):
                     # subclasses buffer what they need (the adaptive KL
@@ -765,11 +790,31 @@ class JaxBaseTrainer(BaseRLTrainer):
                     if wi and self.iter_count % wi == 0:
                         self.log_param_watch()
 
-                    # Mid-batch reaction stays single-process-only: a
+                    # Cross-host consistency guard: every N steps, compare
+                    # [step, replicated-param crc, rng crc] fingerprints and
+                    # raise HostDesync naming the diverged host — keyed on
+                    # iter_count so every host enters the collective at the
+                    # identical step.
+                    di = self.config.train.desync_check_interval
+                    if di and self.iter_count % di == 0:
+                        self._check_desync()
+
+                    # Mid-batch reaction is single-process by default: a
                     # per-step agreement collective would tax the hot loop,
                     # and a local-only save would deadlock a pod — pods
-                    # react at the next batch boundary instead.
+                    # react at the next batch boundary, or every
+                    # train.preempt_check_interval steps when set (tighter
+                    # preemption windows at one tiny allgather per N steps).
                     if jax.process_count() == 1 and self._preempted:
+                        self._save_on_preemption()
+                        return None
+                    pi = self.config.train.preempt_check_interval
+                    if (
+                        pi
+                        and jax.process_count() > 1
+                        and self.iter_count % pi == 0
+                        and self._preemption_agreed()
+                    ):
                         self._save_on_preemption()
                         return None
 
@@ -858,6 +903,51 @@ class JaxBaseTrainer(BaseRLTrainer):
                 step=self.iter_count,
             )
 
+    def _fire_host_faults(self):
+        """Per-PROCESS fault drills (trlx_tpu/resilience/faults.py): each
+        worker reads its OWN ``TRLX_TPU_FAULTS`` env, so a 2-process drill
+        can slow/diverge/hang/kill one host and exercise the detection
+        machinery (collective_guard, desync guard, heartbeats) on the rest."""
+        if not self.fault_plan:
+            return
+        step = self.iter_count
+        if self.fault_plan.fire("slow_host", step):
+            # Straggler, not a death: long enough to dominate a stall
+            # report, short enough (vs. a sane deadline) not to abort.
+            time.sleep(float(os.environ.get("TRLX_TPU_SLOW_SECONDS", "2")))
+        if self.fault_plan.fire("host_desync", step):
+            # Silent state divergence on THIS host only: perturb the local
+            # replicas of one replicated param leaf — no collective, the
+            # other hosts keep the original values — for the fingerprint
+            # guard to catch within one check period.
+            self.state = self.state.replace(
+                params=dist_res.perturb_local_replicas(self.state.params)
+            )
+        if self.fault_plan.fire("host_hang", step):
+            # Alive-but-wedged: the daemon heartbeat thread keeps writing
+            # (written_t advances) while the progress stamp freezes — the
+            # exact signature stall_report uses to name this host when the
+            # peers' collective_guard deadlines fire.
+            if self.heartbeat is not None:
+                self.heartbeat.beat(step=step, phase="fault:host_hang")
+            time.sleep(float(os.environ.get("TRLX_TPU_HANG_SECONDS", "3600")))
+        if self.fault_plan.fire("host_kill", step):
+            # Hard death: no cleanup, no final heartbeat — peers see the
+            # heartbeat file age out and their next collective deadline.
+            os._exit(1)
+
+    def _check_desync(self):
+        """Cross-host consistency guard: allgather and compare each host's
+        [step counter, replicated-param crc32, rng crc32] fingerprint.
+        Every host sees the identical gathered matrix, so a mismatch raises
+        the identical HostDesync (naming the diverged host) everywhere — a
+        coordinated abort, never a one-sided hang."""
+        if jax.process_count() == 1:
+            return
+        dist_res.verify_fingerprints(
+            dist_res.host_fingerprint(self.iter_count, self.state.params, rng=self.rng)
+        )
+
     def _rollback(self):
         """Divergence watchdog response: restore the last intact checkpoint,
         decay the LR, and resume — aborting after ``train.max_rollbacks``."""
@@ -943,6 +1033,12 @@ class JaxBaseTrainer(BaseRLTrainer):
             return None
         directory, name = pending["directory"], pending["name"]
         self._ckptr.wait_until_finished()
+        if jax.process_count() > 1:
+            # All-hosts-committed barrier: every host's shards are on disk
+            # before rank 0 writes the sidecars and flips latest.txt — the
+            # pointer must never lead a straggler host's data, or a
+            # preemption save could advertise a checkpoint missing shards.
+            barrier(f"ckpt_commit_{name}")
         if getattr(self, "tracker", None) is not None:
             self.tracker.log(
                 {"save_time": time.time() - pending["t0"]}, step=self.iter_count
@@ -969,6 +1065,11 @@ class JaxBaseTrainer(BaseRLTrainer):
             ckpt_util.gc_checkpoints(
                 directory, self.config.train.keep_checkpoints, protect=(name,)
             )
+        if jax.process_count() > 1:
+            # Visibility barrier: no host returns (and, on a preemption
+            # save, exits) until rank 0's pointer flip is durable — every
+            # host's view of "the save is done" includes latest.txt.
+            barrier(f"ckpt_visible_{name}")
         return name
 
     def save_pretrained(self, out_dir: str, family: Optional[str] = None):
@@ -1069,30 +1170,50 @@ class JaxBaseTrainer(BaseRLTrainer):
                 if os.path.isabs(cand) and os.path.exists(cand)
                 else os.path.join(directory, name)
             )
-            if not os.path.isdir(path):
-                attempts.append(f"{name}: checkpoint directory missing")
-                continue
-            ok, reason = ckpt_util.verify_checkpoint(os.path.dirname(path), name)
-            if not ok:
-                attempts.append(f"{name}: {reason}")
-                continue
-            try:
-                self.state = self._ckptr.restore(path, self.state)
-            except Exception as e:  # noqa: BLE001 — fall back to older checkpoint
-                attempts.append(f"{name}: orbax restore failed ({type(e).__name__}: {e})")
-                continue
-            self.last_restore_fallback = i > 0
-            if i > 0 and is_main_process():
-                print(
-                    f"[trlx_tpu.resilience] latest checkpoint unusable "
-                    f"({'; '.join(attempts)}) — fell back to {name}",
-                    file=sys.stderr,
-                )
-            host_file = f"{path}.host.json"
-            if os.path.exists(host_file):
-                with open(host_file) as f:
-                    self.load_host_state(json.load(f))
-            return self.state
+            # In-use marker: another process GC-ing this directory (e.g. a
+            # concurrent run finalizing its own save) must not delete a
+            # candidate out from under the verify/restore below.
+            with ckpt_util.mark_in_use(os.path.dirname(path), name):
+                if not os.path.isdir(path):
+                    ok, reason = False, "checkpoint directory missing"
+                else:
+                    ok, reason = ckpt_util.verify_checkpoint(os.path.dirname(path), name)
+                if jax.process_count() > 1:
+                    # Cross-host agreement BEFORE the collective restore:
+                    # the orbax restore must be entered by every host or by
+                    # none, and a checkpoint torn on ONE host's view of the
+                    # filesystem fails the candidate for ALL — otherwise
+                    # the fleet deadlocks split across two candidates.
+                    from trlx_tpu.parallel.mesh import allgather_host
+
+                    oks = allgather_host(np.asarray([ok], dtype=np.int32)).reshape(-1)
+                    if not oks.all():
+                        bad = [int(p) for p in np.flatnonzero(oks == 0)]
+                        attempts.append(
+                            f"{name}: failed verification on host(s) {bad}"
+                            + (f" (local: {reason})" if not ok else "")
+                        )
+                        continue
+                elif not ok:
+                    attempts.append(f"{name}: {reason}")
+                    continue
+                try:
+                    self.state = self._ckptr.restore(path, self.state)
+                except Exception as e:  # noqa: BLE001 — fall back to older checkpoint
+                    attempts.append(f"{name}: orbax restore failed ({type(e).__name__}: {e})")
+                    continue
+                self.last_restore_fallback = i > 0
+                if i > 0 and is_main_process():
+                    print(
+                        f"[trlx_tpu.resilience] latest checkpoint unusable "
+                        f"({'; '.join(attempts)}) — fell back to {name}",
+                        file=sys.stderr,
+                    )
+                host_file = f"{path}.host.json"
+                if os.path.exists(host_file):
+                    with open(host_file) as f:
+                        self.load_host_state(json.load(f))
+                return self.state
 
         raise CheckpointError(
             f"no restorable checkpoint in {directory} — every candidate "

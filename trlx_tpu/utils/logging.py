@@ -28,6 +28,35 @@ except Exception:
 from trlx_tpu.parallel.mesh import is_main_process
 
 
+def read_jsonl(path: str):
+    """Read a metrics.jsonl written by Tracker, tolerating a torn final line.
+
+    A host killed mid-append (preemption, ``host_kill`` drill) can leave a
+    truncated trailing record; every complete record before it is still
+    good, so readers (resume tooling, acceptance_network._trajectories)
+    must not die on the tail. A malformed line in the MIDDLE of the file is
+    real corruption and still raises."""
+    records = []
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            rest = b"".join(lines[i + 1 :]).strip()
+            if rest:
+                raise
+            warnings.warn(
+                f"{path}: dropped torn final record ({len(line)} bytes) — "
+                "the writer was killed mid-append",
+                stacklevel=2,
+            )
+            break
+    return records
+
+
 def _tracker_disabled() -> bool:
     if "TRLX_TPU_DISABLE_TRACKER" in os.environ:
         return os.environ["TRLX_TPU_DISABLE_TRACKER"] not in ("", "0")
@@ -61,9 +90,16 @@ class Tracker:
                 project=project_name, name=run_name, entity=entity_name, config=config
             )
         os.makedirs(log_dir, exist_ok=True)
-        self._file = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        # Unbuffered O_APPEND: each record lands as ONE write(2) syscall
+        # (_write_record), so a killed process (preemption, host_kill drill)
+        # can tear at most the final line — which read_jsonl tolerates — and
+        # concurrent appenders can never interleave mid-record.
+        self._file = open(os.path.join(log_dir, "metrics.jsonl"), "ab", buffering=0)
         if config:
-            self._file.write(json.dumps({"_config": {k: str(v) for k, v in config.items()}}) + "\n")
+            self._write_record({"_config": {k: str(v) for k, v in config.items()}})
+
+    def _write_record(self, record: Dict[str, Any]):
+        self._file.write((json.dumps(record) + "\n").encode("utf-8"))
 
     def log(self, stats: Dict[str, Any], step: Optional[int] = None):
         if not self.enabled:
@@ -76,9 +112,7 @@ class Tracker:
                 scalars[k] = str(v)
         if self._wandb is not None:
             self._wandb.log(scalars, step=step)
-        rec = {"step": step, "t": round(time.time(), 3), **scalars}
-        self._file.write(json.dumps(rec) + "\n")
-        self._file.flush()
+        self._write_record({"step": step, "t": round(time.time(), 3), **scalars})
 
     def log_table(self, name: str, columns, rows, step: Optional[int] = None):
         """Sample tables (≈ wandb.Table at
@@ -92,8 +126,7 @@ class Tracker:
         for row in preview:
             cells = " | ".join(str(c)[:60] for c in row)
             print(f"  {cells}", file=sys.stderr)
-        self._file.write(json.dumps({"table": name, "step": step, "columns": list(columns), "rows": [[str(c) for c in r] for r in rows[:32]]}) + "\n")
-        self._file.flush()
+        self._write_record({"table": name, "step": step, "columns": list(columns), "rows": [[str(c) for c in r] for r in rows[:32]]})
 
     def log_histogram(self, name: str, values, step: Optional[int] = None):
         """Distribution logging (≈ wandb.Histogram of qs/vs/adv during ILQL
@@ -108,22 +141,18 @@ class Tracker:
             return
         if self._wandb is not None:
             self._wandb.log({name: wandb.Histogram(values)}, step=step)
-        self._file.write(
-            json.dumps(
-                {
-                    "histogram": name,
-                    "step": step,
-                    "count": int(values.size),
-                    "mean": float(values.mean()),
-                    "std": float(values.std()),
-                    "min": float(values.min()),
-                    "p50": float(np.median(values)),
-                    "max": float(values.max()),
-                }
-            )
-            + "\n"
+        self._write_record(
+            {
+                "histogram": name,
+                "step": step,
+                "count": int(values.size),
+                "mean": float(values.mean()),
+                "std": float(values.std()),
+                "min": float(values.min()),
+                "p50": float(np.median(values)),
+                "max": float(values.max()),
+            }
         )
-        self._file.flush()
 
     def finish(self):
         if self._wandb is not None:
