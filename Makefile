@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke acceptance-network
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -54,6 +54,12 @@ bench:
 # CPU head-to-head vs the reference's own training loop (writes HEADTOHEAD.json).
 bench-reference:
 	python bench_reference.py
+
+# CPU decode-path smoke, ~1 min: interpret-mode flash-decode parity at the
+# flagship head layout + static tile legality at the full bench shape +
+# a tiny bucketed rollout (trace count <= n_buckets). Writes BENCH_SMOKE.json.
+bench-smoke:
+	$(TEST_ENV) python bench_smoke.py
 
 # Network-day acceptance: the four reference acceptance examples + gates in
 # one command, distilled to ACCEPTANCE.json (RUNBOOK.md). Offline it still

@@ -293,6 +293,22 @@ def main():
         jax.default_backend() == "tpu" and os.environ.get("BENCH_SUBPROC", "1") == "1"
     )
 
+    # Non-OOM candidate failures (a child rc!=0, an in-process exception) are
+    # RECORDED here and the size ladder continues — they must never abort the
+    # whole bench. Observed live (BENCH_r05): a Mosaic lowering ValueError in
+    # the flagship child raised RuntimeError at this layer and the run
+    # produced no JSON at all, when the next size down would have run fine.
+    failed_candidates = []
+
+    def _record_failure(cand, rc, tail):
+        failed_candidates.append(
+            {"candidate": cand[0], "rc": rc, "tail": tail[-2000:] if tail else ""}
+        )
+        print(
+            f"bench: {cand[0]} failed (rc={rc}); recorded, trying next size",
+            file=sys.stderr,
+        )
+
     def try_one(cand, _retried=False, **kwargs):
         nonlocal use_subproc
         if not use_subproc:
@@ -306,7 +322,11 @@ def main():
                         # would abort the whole bench in in-process mode.
                         print("bench: transient backend failure; retrying this size once", file=sys.stderr)
                         return try_one(cand, _retried=True, **kwargs)
-                    raise
+                    _record_failure(cand, None, f"{type(e).__name__}: {str(e)}")
+                    e.__traceback__ = None
+                    del e
+                    gc.collect()
+                    return None
                 # Drop the traceback BEFORE collecting: its frames pin the
                 # failed trainer's device arrays.
                 e.__traceback__ = None
@@ -344,7 +364,8 @@ def main():
                 )
                 return try_one(cand, **kwargs)
             sys.stderr.write(proc.stderr[-4000:])
-            raise RuntimeError(f"bench subprocess failed for {cand[0]} (rc={proc.returncode})")
+            _record_failure(cand, proc.returncode, proc.stderr)
+            return None
         if proc.stderr.strip():
             sys.stderr.write(proc.stderr[-1500:])
         return json.loads(proc.stdout.strip().splitlines()[-1])
@@ -393,7 +414,7 @@ def main():
         for cand in cands:
             result = try_one(cand, **kwargs)
             if result is None:
-                print(f"bench: {cand[0]} OOM, trying next size", file=sys.stderr)
+                print(f"bench: {cand[0]} did not complete, trying next size", file=sys.stderr)
                 continue
             if _degraded(cand, result):
                 if use_subproc:
@@ -444,7 +465,17 @@ def main():
 
     result = first_fitting(candidates)
     if result is None:
-        raise RuntimeError("no bench size fit the device")
+        detail = "; ".join(
+            f"{f['candidate']} rc={f['rc']}" for f in failed_candidates
+        )
+        raise RuntimeError(
+            "no bench size fit the device"
+            + (f" (non-OOM failures: {detail})" if detail else "")
+        )
+    if failed_candidates:
+        # Published alongside the flagship number: which larger sizes failed
+        # for non-OOM reasons, with the stderr tail for triage.
+        result["failed_candidates"] = failed_candidates
     def _optional_point(label, fn):
         """Optional points are failure-isolated: ANY error in one (transient
         backend states, subprocess deaths) must cost that point only — never

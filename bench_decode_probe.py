@@ -60,6 +60,15 @@ def run_combo(label, overrides):
         print(f"[probe] {label} FAILED rc={proc.returncode}\n{proc.stderr[-1500:]}", file=sys.stderr)
         return None
     rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    for f in rec.get("failed_candidates", []):
+        # bench.py records non-OOM candidate failures and falls through to a
+        # smaller size — the probe must say so, because a silently-smaller
+        # flagship shape would corrupt the byte model's combo comparison.
+        print(
+            f"[probe] {label}: candidate {f['candidate']} failed rc={f['rc']} "
+            f"before the measured size\n{f['tail'][-500:]}",
+            file=sys.stderr,
+        )
     model = rec.get("decode_hbm_model")
     if not model:
         print(f"[probe] {label}: no decode_hbm_model in output", file=sys.stderr)

@@ -1,0 +1,141 @@
+"""CPU smoke of the decode hot path: minutes, no TPU, CI-safe.
+
+Two probes covering exactly what BENCH_r05 showed CPU CI was blind to:
+
+1. kernel — the flash-decode Pallas kernel runs in INTERPRET mode at the
+   flagship head layout (h=16, d=256) over an int8 KV cache with a ragged
+   cache length, and must match the model layer's dequantize+einsum fallback.
+   Plus the static tile-legality check at the full bench shape (B=32, T=832),
+   which is the part of the Mosaic lowering that CAN be enforced off-TPU.
+
+2. rollout — a tiny bucketed rollout: PromptPipeline with bucket widths
+   feeding make_generate_fn, asserting the compiled-program count stays
+   <= n_buckets (the trace-count hook) and the decode metrics helper returns
+   sane numbers.
+
+Writes BENCH_SMOKE.json and prints one JSON summary line; exits 1 on any
+failure. Wall time ~1-2 min on a laptop CPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(REPO, "BENCH_SMOKE.json")
+
+
+def kernel_probe():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.lm import quantize_kv
+    from trlx_tpu.ops.decode_attention import decode_attention
+    from trlx_tpu.ops.tiling import check_layout, decode_block_layout
+
+    # Static legality at the REAL flagship decode shape (the lowering rule
+    # that used to only fire on device).
+    check_layout(decode_block_layout(32, 832, 16, 256, True))
+    check_layout(decode_block_layout(32, 832, 16, 256, False))
+
+    # Interpret-mode parity at the flagship head layout, batch scaled down
+    # (interpret mode is a Python loop; B=32 would take minutes for no
+    # additional coverage).
+    B, T, h, d = 2, 300, 16, 256  # ragged: T % 128 != 0
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(B, h, d)).astype(np.float32)
+    k = rng.normal(size=(B, T, h, d)).astype(np.float32)
+    v = rng.normal(size=(B, T, h, d)).astype(np.float32)
+    valid = np.ones((B, T), dtype=bool)
+    valid[0, :7] = False  # left padding
+    bias = np.where(valid, 0.0, -1e9).astype(np.float32)
+
+    kq, ks = quantize_kv(jnp.asarray(k))
+    vq, vs = quantize_kv(jnp.asarray(v))
+    t0 = time.time()
+    out = decode_attention(
+        jnp.asarray(q), kq, vq, ks, vs, jnp.asarray(bias), scale=d ** -0.5, interpret=True
+    )
+    kernel_s = time.time() - t0
+
+    k_dq = kq.astype(jnp.float32) * ks[..., None].astype(jnp.float32)
+    v_dq = vq.astype(jnp.float32) * vs[..., None].astype(jnp.float32)
+    scores = jnp.einsum("bhd,bkhd->bhk", jnp.asarray(q), k_dq) * d ** -0.5 + bias[:, None, :]
+    ref = jnp.einsum("bhk,bkhd->bhd", jax.nn.softmax(scores, axis=-1), v_dq)
+    err = float(jnp.max(jnp.abs(out[:, 0] - ref)))
+    assert err < 2e-4, f"kernel parity failed: maxerr={err}"
+    return {"shape": [B, T, h, d], "maxerr": err, "seconds": round(kernel_s, 2)}
+
+
+def rollout_probe():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models import LMConfig, LMWithValueHead
+    from trlx_tpu.ops.generate import make_generate_fn
+    from trlx_tpu.ops.sampling import GenerateConfig
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+    from trlx_tpu.trainer.base import JaxBaseTrainer
+
+    cfg = LMConfig(vocab_size=29, n_layer=1, n_head=2, d_model=16, max_position=32, dtype="float32")
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids0 = jnp.ones((2, 4), jnp.int32)
+    params = {"params": model.init(rng, ids0, jnp.ones_like(ids0))["params"]}
+    gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, eos_token_id=None, pad_token_id=0)
+    gen = make_generate_fn(model, gcfg)
+
+    prng = np.random.default_rng(1)
+    prompts = [list(prng.integers(2, 28, size=n)) for n in (2, 3, 5, 7, 8, 4, 6, 3)]
+    pipe = PromptPipeline(prompts, tokenizer=None, max_prompt_length=8, bucket_widths=(4, 8))
+    loader = pipe.create_loader(batch_size=2, shuffle=True, drop_last=False, seed=2)
+
+    gen_tokens = 0
+    t0 = time.time()
+    for i, batch in enumerate(loader):
+        toks, mask = gen(
+            params,
+            jnp.asarray(batch["input_ids"]),
+            jnp.asarray(batch["attention_mask"]),
+            jax.random.PRNGKey(i),
+        )
+        P = batch["input_ids"].shape[1]
+        stats = JaxBaseTrainer.rollout_decode_stats(np.asarray(mask), P)
+        assert 0 < stats["decode_steps"] <= stats["decode_step_budget"]
+        gen_tokens += stats["gen_tokens"]
+    gen_s = time.time() - t0
+
+    n_buckets = len(pipe.bucket_widths)
+    assert gen.num_traces <= n_buckets, (
+        f"bucketing leak: {gen.num_traces} generate traces for {n_buckets} "
+        f"buckets (shapes: {gen.traced_shapes})"
+    )
+    return {
+        "buckets": list(pipe.bucket_widths),
+        "generate_traces": gen.num_traces,
+        "gen_tokens": gen_tokens,
+        "tokens_per_s": round(gen_tokens / max(gen_s, 1e-9), 1),
+        "seconds": round(gen_s, 2),
+    }
+
+
+def main():
+    t0 = time.time()
+    result = {"kernel": kernel_probe(), "rollout": rollout_probe()}
+    result["wall_s"] = round(time.time() - t0, 1)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"smoke": "ok", **result}))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — CI needs the one-line verdict
+        print(json.dumps({"smoke": "FAIL", "error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

@@ -1,0 +1,130 @@
+"""Prompt-length bucketing: the rollout path compiles per BUCKET, not per
+novel ragged shape.
+
+Tier-1 (fast, CPU): the loader/pipeline mechanics are pure numpy, and the
+trace-count proof runs a tiny model with a 2-token budget. The acceptance
+property is the last test: over mixed prompt lengths, the generate fn traces
+at most n_buckets distinct programs (counted via make_generate_fn's
+trace-count hook, which increments INSIDE the traced body)."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.pipeline import BucketedBatchLoader
+from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline, normalize_buckets
+
+
+def test_normalize_buckets():
+    assert normalize_buckets(None, 64) is None
+    assert normalize_buckets((), 64) is None
+    # sorted, deduped, clamped to (0, max], max always terminal
+    assert normalize_buckets([8, 4, 8], 16) == (4, 8, 16)
+    assert normalize_buckets([4, 16], 16) == (4, 16)
+    assert normalize_buckets([99, -3, 0], 16) == (16,)
+
+
+def _tensor_prompts():
+    # Lengths 2..9 — two buckets under widths (4, 8): {2,3,4} -> 4, {5..8} -> 8,
+    # and the length-9 prompt truncates into the terminal bucket (8).
+    rng = np.random.default_rng(0)
+    return [list(rng.integers(2, 50, size=n)) for n in (2, 3, 4, 5, 6, 7, 8, 9, 3, 6)]
+
+
+def test_pipeline_buckets_pad_to_smallest_fitting_width():
+    prompts = _tensor_prompts()
+    pipe = PromptPipeline(prompts, tokenizer=None, max_prompt_length=8, bucket_widths=(4, 8))
+    assert pipe.bucket_widths == (4, 8)
+    # every prompt landed in exactly one bucket
+    assert sum(len(r) for r in pipe._bucket_rows.values()) == len(prompts)
+    for w, ids in pipe._bucket_ids.items():
+        assert ids.shape[1] == w
+        msk = pipe._bucket_mask[w]
+        for row, m in zip(ids, msk):
+            n = int(m.sum())
+            assert n <= w
+            # left-padded: validity is the RIGHT edge
+            assert (m[w - n :] == 1).all() and (m[: w - n] == 0).all()
+    # the max-width view is intact for non-bucketed consumers
+    assert pipe.input_ids.shape == (len(prompts), 8)
+
+
+def test_bucketed_loader_batches_are_bucket_uniform():
+    prompts = _tensor_prompts()
+    pipe = PromptPipeline(prompts, tokenizer=None, max_prompt_length=8, bucket_widths=(4, 8))
+    loader = pipe.create_loader(batch_size=3, shuffle=True, drop_last=False, seed=1)
+    assert isinstance(loader, BucketedBatchLoader)
+    widths = set()
+    rows = 0
+    for batch, n_valid in loader.iter_with_valid():
+        assert batch["input_ids"].shape == batch["attention_mask"].shape
+        assert batch["input_ids"].shape[0] == 3  # static batch, wrap-padded
+        widths.add(batch["input_ids"].shape[1])
+        rows += n_valid
+    assert widths <= {4, 8}
+    assert rows == len(prompts)  # every prompt seen exactly once as a valid row
+
+
+def test_bucketed_loader_wraps_within_bucket():
+    # bucket "a" has 2 rows, batch_size 4: the wrap pad must reuse bucket-"a"
+    # rows, never leak rows from bucket "b"
+    buckets = {"a": [0, 1], "b": [2, 3, 4]}
+    seen = []
+
+    def collate(key, ixs):
+        seen.append((key, list(ixs)))
+        return key, np.asarray(ixs)
+
+    loader = BucketedBatchLoader(buckets, 4, collate, drop_last=False)
+    batches = list(loader.iter_with_valid())
+    assert len(batches) == len(loader) == 2
+    for (key, ixs), n_valid in batches:
+        member = set(buckets[key])
+        assert set(ixs.tolist()) <= member
+        assert n_valid == len(member) if len(member) < 4 else 4
+
+
+def test_rollout_decode_stats():
+    from trlx_tpu.trainer.base import JaxBaseTrainer
+
+    # P=3 prompt, budget 4; row 0 generated 2 tokens, row 1 all 4 — the
+    # while_loop ran until the longest live row: 4 steps.
+    mask = np.array(
+        [[1, 1, 1, 1, 1, 0, 0], [0, 1, 1, 1, 1, 1, 1]], dtype=np.int32
+    )
+    s = JaxBaseTrainer.rollout_decode_stats(mask, 3)
+    assert s == {"gen_tokens": 6, "decode_steps": 4, "decode_step_budget": 4}
+
+
+def test_generate_traces_bounded_by_buckets():
+    """Mixed prompt lengths through a bucketed loader: the jitted generate fn
+    must trace at most n_buckets programs (one per bucket width), and the
+    trace count must not grow when a bucket shape repeats."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models import LMConfig, LMWithValueHead
+    from trlx_tpu.ops.generate import make_generate_fn
+    from trlx_tpu.ops.sampling import GenerateConfig
+
+    cfg = LMConfig(vocab_size=19, n_layer=1, n_head=2, d_model=16, max_position=32, dtype="float32")
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    init_ids = jnp.ones((2, 4), jnp.int32)
+    params = {"params": model.init(rng, init_ids, jnp.ones_like(init_ids))["params"]}
+
+    gcfg = GenerateConfig(max_new_tokens=2, do_sample=False, eos_token_id=None, pad_token_id=0)
+    gen = make_generate_fn(model, gcfg)
+    assert gen.num_traces == 0
+
+    pipe = PromptPipeline(_tensor_prompts(), tokenizer=None, max_prompt_length=8, bucket_widths=(4, 8))
+    loader = pipe.create_loader(batch_size=2, shuffle=True, drop_last=False, seed=3)
+    n_batches = 0
+    for batch in loader:
+        ids = jnp.asarray(batch["input_ids"] % cfg.vocab_size)
+        msk = jnp.asarray(batch["attention_mask"])
+        toks, m = gen(params, ids, msk, jax.random.PRNGKey(n_batches))
+        assert toks.shape == (2, ids.shape[1] + 2)
+        n_batches += 1
+    assert n_batches > len(pipe.bucket_widths)  # shapes really did repeat
+    assert gen.num_traces <= len(pipe.bucket_widths)
+    assert {s[1] for s in gen.traced_shapes} <= {4, 8}

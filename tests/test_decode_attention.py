@@ -1,10 +1,12 @@
-"""Fused decode-attention kernel vs the einsum reference math (interpret mode).
+"""Flash-decode kernel vs the einsum reference math (interpret mode).
 
 The kernel's contract: bit-comparable attention output to the model layer's
 einsum decode path — including the dequant-folding identity
 (ks·dot(K_int8, q) == dot(K_int8·ks, q) up to fp32 reassociation) and the
-additive bias masking. CPU CI runs the same kernel code via pallas
-interpret mode (the on-TPU routing gate is tested separately)."""
+additive bias masking — for int8 AND bf16 caches, tile-aligned AND ragged
+cache lengths (the masked tail block), and fully-masked rows. CPU CI runs
+the same kernel code via pallas interpret mode (the on-TPU routing gate and
+lowering probe are tested separately)."""
 
 import numpy as np
 import pytest
@@ -13,7 +15,13 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.models.lm import quantize_kv
-from trlx_tpu.ops.decode_attention import decode_attn_eligible, decode_attention
+from trlx_tpu.ops.decode_attention import (
+    BLOCK_T,
+    decode_attn_eligible,
+    decode_attn_supported,
+    decode_attention,
+    pick_t_block,
+)
 
 pytestmark = pytest.mark.slow
 
@@ -26,21 +34,27 @@ def _reference_einsum(q, k, v, bias_row, scale):
     return jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
 
 
-def _setup(B=2, T=64, h=2, d=128, seed=0):
+def _setup(B=2, T=64, h=2, d=128, seed=0, dtype=np.float32):
     rng = np.random.default_rng(seed)
-    q = rng.normal(size=(B, h, d)).astype(np.float32)
-    k = rng.normal(size=(B, T, h, d)).astype(np.float32)
-    v = rng.normal(size=(B, T, h, d)).astype(np.float32)
+    q = rng.normal(size=(B, h, d)).astype(dtype)
+    k = rng.normal(size=(B, T, h, d)).astype(dtype)
+    v = rng.normal(size=(B, T, h, d)).astype(dtype)
     # validity mask with left padding + causal tail invalid
     valid = np.ones((B, T), dtype=bool)
-    valid[0, :5] = False
-    valid[1, T - 8 :] = False
+    valid[0, : min(5, T - 1)] = False
+    valid[1, T - min(8, T - 1) :] = False
     bias = np.where(valid, 0.0, -1e9).astype(np.float32)
     return q, k, v, bias
 
 
-def test_plain_matches_einsum():
-    q, k, v, bias = _setup()
+# T sweep: single full (unaligned) block, exactly one block, a ragged
+# multi-block tail, and an aligned multi-block cache.
+RAGGED_AND_ALIGNED_T = (64, BLOCK_T, BLOCK_T + 72, 3 * BLOCK_T)
+
+
+@pytest.mark.parametrize("T", RAGGED_AND_ALIGNED_T)
+def test_plain_matches_einsum(T):
+    q, k, v, bias = _setup(T=T)
     out = decode_attention(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, None,
         jnp.asarray(bias), scale=0.125, interpret=True,
@@ -49,8 +63,9 @@ def test_plain_matches_einsum():
     np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
-def test_quant_matches_dequantized_einsum():
-    q, k, v, bias = _setup(seed=1)
+@pytest.mark.parametrize("T", RAGGED_AND_ALIGNED_T)
+def test_quant_matches_dequantized_einsum(T):
+    q, k, v, bias = _setup(T=T, seed=1)
     kq, ks = quantize_kv(jnp.asarray(k))
     vq, vs = quantize_kv(jnp.asarray(v))
     out = decode_attention(
@@ -63,20 +78,78 @@ def test_quant_matches_dequantized_einsum():
     np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
-def test_fully_masked_rows_are_finite():
-    q, k, v, bias = _setup(seed=2)
+def test_bf16_cache_matches_einsum():
+    """Non-quantized caches are the compute dtype (bf16 in production)."""
+    q, k, v, bias = _setup(T=BLOCK_T + 40, seed=3)
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb = jnp.asarray(k, jnp.bfloat16)
+    vb = jnp.asarray(v, jnp.bfloat16)
+    out = decode_attention(qb, kb, vb, None, None, jnp.asarray(bias), scale=0.125, interpret=True)
+    ref = _reference_einsum(qb, kb, vb, bias, 0.125)
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0], np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+@pytest.mark.parametrize("T", (64, BLOCK_T + 72))
+def test_fully_masked_rows_match_einsum(T):
+    """A fully-masked row degrades to softmax over the raw scores (the
+    additive -1e9 bias cancels in the softmax shift) — same as einsum, and
+    always finite."""
+    q, k, v, bias = _setup(T=T, seed=2)
     bias[0, :] = -1e9  # every key invalid for row 0
     out = decode_attention(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, None,
         jnp.asarray(bias), scale=0.125, interpret=True,
     )
     assert np.isfinite(np.asarray(out)).all()
+    ref = _reference_einsum(q, k, v, bias, 0.125)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_bench_head_layout_ragged():
+    """The flagship bench head layout [h=16, d=256] at a ragged cache length
+    — the exact shape class BENCH_r05 crashed on (there with B=32)."""
+    q, k, v, bias = _setup(B=2, T=832, h=16, d=256, seed=4)
+    kq, ks = quantize_kv(jnp.asarray(k))
+    vq, vs = quantize_kv(jnp.asarray(v))
+    out = decode_attention(
+        jnp.asarray(q), kq, vq, ks, vs, jnp.asarray(bias), scale=0.0625, interpret=True,
+    )
+    k_dq = kq.astype(jnp.float32) * ks[..., None].astype(jnp.float32)
+    v_dq = vq.astype(jnp.float32) * vs[..., None].astype(jnp.float32)
+    ref = _reference_einsum(q, k_dq, v_dq, bias, 0.0625)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_pick_t_block():
+    assert pick_t_block(64) == 64          # short cache: one full block
+    assert pick_t_block(100) == 100        # unaligned short cache is legal as-is
+    assert pick_t_block(BLOCK_T) == BLOCK_T
+    assert pick_t_block(BLOCK_T + 1) == BLOCK_T  # long cache streams in blocks
+    assert pick_t_block(832) == BLOCK_T
 
 
 def test_eligibility_gate():
     # off-TPU the gate must refuse (einsum path stands in CI)
     assert not decode_attn_eligible(16, 256, 1024, True) or jax.default_backend() == "tpu"
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu":  # pragma: no cover — CPU CI
         assert decode_attn_eligible(16, 256, 1024, True)
+        # masked tail: unaligned cache lengths are eligible now
+        assert decode_attn_eligible(16, 256, 831, True)
         assert not decode_attn_eligible(16, 200, 1024, True)  # lanes not 128-aligned
-        assert not decode_attn_eligible(16, 256, 1000, True)  # int8 sublane tile
+        assert not decode_attn_eligible(3, 256, 1024, True)  # sub-tile head count
+
+
+def test_supported_probe_is_cached_and_safe_off_tpu():
+    """The routing probe must answer (and cache) without a TPU: the static
+    tile check runs everywhere, the Mosaic lowering attempt only on TPU."""
+    from trlx_tpu.ops import decode_attention as da
+
+    da._PROBE_CACHE.clear()
+    assert decode_attn_supported(32, 832, 16, 256, True)
+    assert len(da._PROBE_CACHE) == 1
+    # second call: pure cache hit (no recomputation observable, but the key
+    # count must not grow)
+    assert decode_attn_supported(32, 832, 16, 256, True)
+    assert len(da._PROBE_CACHE) == 1

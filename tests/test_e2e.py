@@ -50,6 +50,39 @@ def test_ppo_e2e_smoke(task, tmp_path):
     assert len(model.store) > 0
 
 
+def test_ppo_e2e_bucketed_prompts(task, tmp_path):
+    """Mixed prompt lengths with prompt_buckets: rollouts generate at
+    per-bucket widths, the store and train step stay at the single global
+    prompt_length (the orchestrator re-pads queries before the push), and
+    training completes. The trace-count proof lives in test_bucketing; this
+    is the full train-loop integration."""
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    config.method.gen_kwargs["prompt_length"] = 3
+    config.method.gen_kwargs["max_new_tokens"] = 5
+    config.method.gen_kwargs["prompt_buckets"] = [1, 3]
+    rng = np.random.default_rng(7)
+    # walk prefixes of mixed lengths 1..3 (nodes stay in-vocab; the bigram
+    # mask only constrains GENERATED steps)
+    prompts = [list(rng.integers(1, 15, size=rng.integers(1, 4))) for _ in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.prompt_buckets == (1, 3)
+    assert model.iter_count >= 6
+    assert len(model.store) > 0
+    # stored queries were re-padded to the GLOBAL prompt width
+    el = model.store[0]
+    assert el.query_tensor.shape[0] == model.prompt_length == 3
+    assert el.response_tensor.shape[0] == model.response_length == 5
+
+
 def test_ilql_e2e_smoke(task, tmp_path):
     walks, logit_mask, metric_fn, reward_fn = task
     config = shrink(base_config("ilql", 15, 8))

@@ -1,0 +1,120 @@
+"""Tier-1 (fast, CPU) static tile-legality tests for the Pallas kernels.
+
+The Mosaic last-two-dims (8, 128)-or-full rule only bites at lowering time
+on a real TPU — exactly how the old decode-attention kernel's (1, 1, d)
+blocks survived CPU CI and then crashed BENCH_r05 mid-bench. These tests
+run the rule statically at the REAL bench shapes (B=32, h=16, d=256,
+T=832), so an illegal block mapping in ops/ fails the fast tier without
+any TPU."""
+
+import pytest
+
+from trlx_tpu.ops.tiling import (
+    BlockLayout,
+    TileError,
+    block_tile_issues,
+    check_layout,
+    decode_block_layout,
+    flash_block_layout,
+    is_tile_legal,
+)
+
+# The flagship bench decode shape (gptj-l8-d4096-2.0B: chunk 32 rows/host,
+# 16 heads x 256 head_dim, prompt 768 + 64 decoded = 832 cache slots).
+BENCH_B, BENCH_H, BENCH_D, BENCH_T = 32, 16, 256, 832
+
+
+def test_rule_basics():
+    # full blocks are always legal, any size
+    assert not block_tile_issues((3, 5), (3, 5))
+    # divisible blocks are legal
+    assert not block_tile_issues((8, 128), (64, 832))
+    assert not block_tile_issues((16, 256), (32, 16, 256)[1:])
+    # sublane violation
+    assert block_tile_issues((1, 128), (64, 832))
+    # lane violation
+    assert block_tile_issues((8, 100), (64, 832))
+    # block larger than array can never map
+    assert block_tile_issues((16, 128), (8, 832))
+    # rank mismatch is flagged, not crashed on
+    assert block_tile_issues((8, 128), (4, 64, 832))
+    # rank-0/1 blocks are out of scope for the last-two-dims rule
+    assert not block_tile_issues((7,), (9,))
+
+
+def test_old_decode_specs_are_rejected():
+    """The exact block shapes of the pre-rewrite kernel at the BENCH_r05
+    crash shape — the validator must reject every one of them."""
+    old = [
+        BlockLayout("q", (1, 1, BENCH_D), (BENCH_B, BENCH_H, BENCH_D)),
+        BlockLayout("k_cache", (1, BENCH_T, 1, BENCH_D), (BENCH_B, BENCH_T, BENCH_H, BENCH_D)),
+        BlockLayout("k_scale", (1, BENCH_T, 1), (BENCH_B, BENCH_T, BENCH_H)),
+        BlockLayout("bias", (1, BENCH_T), (BENCH_B, BENCH_T)),
+    ]
+    assert not is_tile_legal(old)
+    # and each operand individually carries a violation the error names
+    for lay in old:
+        issues = block_tile_issues(lay.block_shape, lay.array_shape, lay.name)
+        assert issues, f"{lay.name} should be illegal"
+        assert lay.name in issues[0]
+    with pytest.raises(TileError):
+        check_layout(old)
+
+
+@pytest.mark.parametrize("quant", (True, False))
+def test_new_decode_specs_are_legal_at_bench_shape(quant):
+    layouts = decode_block_layout(BENCH_B, BENCH_T, BENCH_H, BENCH_D, quant)
+    check_layout(layouts)  # raises on violation
+    # the q/out blocks really are the full [n_head, head_dim] planes
+    by_name = {l.name: l for l in layouts}
+    assert by_name["q"].block_shape == (1, BENCH_H, BENCH_D)
+    assert by_name["out"].block_shape == (1, BENCH_H, BENCH_D)
+
+
+@pytest.mark.parametrize(
+    "T", (64, 100, 128, 200, 832, 833, 4096)
+)
+def test_decode_specs_legal_for_ragged_cache_lengths(T):
+    """The masked tail removed the cache-length alignment restriction: the
+    layout must stay tile-legal for ANY cache length, aligned or not."""
+    check_layout(decode_block_layout(BENCH_B, T, BENCH_H, BENCH_D, True))
+    check_layout(decode_block_layout(BENCH_B, T, BENCH_H, BENCH_D, False))
+
+
+def test_decode_specs_legal_for_test_model_shapes():
+    """Tiny shapes (CPU test models) are legal too — full blocks everywhere."""
+    check_layout(decode_block_layout(2, 17, 2, 16, True))
+
+
+def test_flash_specs_legal_at_bench_shape():
+    from trlx_tpu.ops.flash_attention import pick_block
+
+    T = 1024
+    blk = pick_block(T)
+    check_layout(flash_block_layout(BENCH_B * BENCH_H, T, BENCH_D, blk, blk))
+
+
+def test_routing_probe_refuses_illegal_layout(monkeypatch):
+    """decode_attn_supported answers False (with a warning, once) when the
+    static layout check fails — the einsum fallback path in the model layer
+    keys off this instead of crashing in Mosaic."""
+    import warnings
+
+    from trlx_tpu.ops import decode_attention as da
+    from trlx_tpu.ops import tiling
+
+    def bad_layout(B, T, h, d, quant, block_t=None):
+        return [BlockLayout("q", (1, 1, d), (B, h, d))]
+
+    da._PROBE_CACHE.clear()
+    monkeypatch.setattr(tiling, "decode_block_layout", bad_layout)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert not da.decode_attn_supported(4, 64, 4, 128, True)
+        assert any("falling back to the einsum" in str(x.message) for x in w)
+    # cached: the next call must not warn again
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert not da.decode_attn_supported(4, 64, 4, 128, True)
+        assert not w
+    da._PROBE_CACHE.clear()

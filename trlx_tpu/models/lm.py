@@ -341,9 +341,29 @@ class Attention(nn.Module):
         decode_kernel_kv = None  # set → route this step through the fused
         # pallas decode-attention kernel (single-token, cache-resident)
         if cache is not None:
-            from trlx_tpu.ops.decode_attention import decode_attn_eligible
+            from trlx_tpu.ops.decode_attention import (
+                decode_attn_eligible,
+                decode_attn_supported,
+            )
 
             single_step = q_len == 1 and attn_bias is not None
+
+            def kernel_ok(quant):
+                # Two gates, both static at trace time: the cheap eligibility
+                # rule, then the one-time cached lowering probe — a shape the
+                # Mosaic lowering rejects warns and takes the einsum path
+                # instead of crashing the compiled rollout program mid-run.
+                return decode_attn_eligible(
+                    cfg.n_head, hd, int(cache[0].shape[1]), quant
+                ) and decode_attn_supported(
+                    int(cache[0].shape[0]),
+                    int(cache[0].shape[1]),
+                    cfg.n_head,
+                    hd,
+                    quant,
+                    dtype,
+                )
+
             if cfg.kv_cache_quant:
                 k_cache, v_cache, ks_cache, vs_cache = cache
                 kq, ks = quantize_kv(k)
@@ -354,9 +374,7 @@ class Attention(nn.Module):
                 vs_cache = jax.lax.dynamic_update_slice(vs_cache, vs, (0, cache_index, 0))
                 new_cache = (k_cache, v_cache, ks_cache, vs_cache)
                 if flash_mask is None:
-                    if single_step and decode_attn_eligible(
-                        cfg.n_head, hd, int(k_cache.shape[1]), True
-                    ):
+                    if single_step and kernel_ok(True):
                         # Kernel reads the int8 cache directly (dequant is
                         # folded into the attention algebra) — HBM traffic
                         # is exactly the int8 bytes.
@@ -376,9 +394,7 @@ class Attention(nn.Module):
                 # prefill) attend over the cache buffers with the
                 # cache-validity bias.
                 if flash_mask is None:
-                    if single_step and decode_attn_eligible(
-                        cfg.n_head, hd, int(k_cache.shape[1]), False
-                    ):
+                    if single_step and kernel_ok(False):
                         decode_kernel_kv = (k_cache, v_cache, None, None)
                     else:
                         k, v = k_cache, v_cache

@@ -1,4 +1,4 @@
-"""Pallas TPU fused single-token decode attention over the (int8) KV cache.
+"""Pallas TPU flash-decode attention over the (int8) KV cache.
 
 Decode is HBM-bound on cache reads. The XLA einsum path for a decode step
 dequantizes the int8 cache into materialized bf16 k/v before the
@@ -12,29 +12,72 @@ traffic is exactly the int8 bytes:
     scores[t] = ks[t] * dot(K_int8[t, :], q) * scale       (per-key scale
     out[d]    = sum_t softmax(scores)[t] * vs[t] * V_int8[t, d]   factors out)
 
-Grid (batch, head): each program streams one head's whole cache row
-[T, head_dim] through VMEM — no [T, T] score matrix, no dequantized copy,
-one pass. Masking is the same additive bias row the einsum path uses.
-Inference-only (decode never differentiates) — no VJP.
+Grid (batch, T-blocks): each program carries ALL heads — the blocks' last
+two dims are the full [n_head, head_dim] (16 x 256 at the bench config),
+which satisfies the Mosaic last-two-dims (8, 128)-or-full tiling rule by
+construction. (The previous revision walked a (batch, head) grid with
+per-head (1, 1, d) q blocks and whole-cache (1, T, 1, d) KV blocks; those
+singleton trailing dims cannot lower — the exact ValueError that crashed
+BENCH_r05 at the flagship size.) The cache streams through VMEM in
+fixed-size T-blocks with online-softmax running max/sum scratch, so
+arbitrarily long caches fit VMEM, and the final (possibly partial) block is
+masked in-kernel — cache lengths need NOT be tile-aligned anymore.
+
+Operand layout notes: per-key int8 scales arrive as [B, T, h] cache columns
+and are transposed to [B, h, T] in the wrapper (an XLA transpose of <1% of
+the cache bytes) so the kernel's scale block is (1, h, bt) — head-major
+like the score matrix, no in-kernel transpose. The bias row is lifted to
+[B, 1, T] for the same reason: a (1, bt) block of a [B, T] array has an
+illegal singleton sublane dim, a (1, 1, bt) block of [B, 1, T] is full/
+divisible. The block layouts live in tiling.decode_block_layout — the
+validator and this wrapper read the SAME description, and the routing layer
+(decode_attn_supported) re-checks it plus a one-time real lowering probe
+before ever tracing the kernel, warning and falling back to einsum instead
+of killing a run mid-bench.
+
+Masking is the same additive bias row the einsum path uses. Inference-only
+(decode never differentiates) — no VJP.
 
 The reference has no counterpart (HF `generate` materializes fp16 caches,
 reference: trlx/model/accelerate_base_model.py:105-116); this is the
 TPU-native design the hardware wants. Engagement mirrors flash_attention:
-real TPU backend + tile-aligned shapes, else the einsum path stands
-(interpret mode keeps CPU CI coverage, tests/test_decode_attention.py).
+real TPU backend, else the einsum path stands (interpret mode keeps CPU CI
+coverage, tests/test_decode_attention.py).
 """
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
 
-from trlx_tpu.ops.flash_attention import _HAVE_PLTPU, _interpret_default, pl
+from trlx_tpu.ops.flash_attention import (
+    _HAVE_PLTPU,
+    M_INIT,
+    MASK_VAL,
+    _interpret_default,
+    _scratch,
+    pl,
+)
 
 if _HAVE_PLTPU:  # pragma: no branch
     from jax.experimental.pallas import tpu as pltpu
 else:  # pragma: no cover
     pltpu = None
+
+# Default KV T-block: 128 slots/block keeps the double-buffered int8 k+v
+# blocks plus their fp32 compute copies comfortably inside ~16 MB VMEM at
+# the bench head layout (128*16*256 int8 = 512 KB/block), and 128 divides
+# the lane tile so the scale/bias blocks stay legal when the cache is
+# longer than one block.
+BLOCK_T = 128
+
+
+def pick_t_block(cache_len: int, block_t: int = BLOCK_T) -> int:
+    """T-block size for a cache of `cache_len` slots: one full block for
+    short caches (a block equal to the array dim is always tile-legal, even
+    unaligned), else the fixed BLOCK_T with the tail masked in-kernel."""
+    return cache_len if cache_len <= block_t else block_t
 
 
 def _vmem(shape, index_map):
@@ -43,92 +86,264 @@ def _vmem(shape, index_map):
     return pl.BlockSpec(shape, index_map)
 
 
-def _attend_rows(q2, k, bias, ks, scale):
-    """Unnormalized fp32 attention weights [T, 1] + their sum [1, 1].
-    All operands stay 2-D (TPU vector layout)."""
+def _compiler_params(interpret):
+    """batch parallel; the T-block walk is the online-softmax accumulation
+    order and must stay sequential."""
+    if not _HAVE_PLTPU or interpret:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    }
+
+
+def _decode_block(q, k, v, ks, vs, bias, it, acc_ref, m_ref, l_ref, *, scale, T, bt):
+    """One T-block of online-softmax decode attention, all heads at once.
+
+    q: [h, d] fp32. k/v: [bt, h, d] (int8 or compute dtype). ks/vs: [h, bt]
+    fp32 per-key scales or None. bias: [1, bt] fp32 additive mask row."""
+    # scores[h, t] = sum_d q[h, d] * k[t, h, d] — batched over heads.
     scores = jax.lax.dot_general(
-        k, q2, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [T, 1] = K @ q
+        q,
+        k.astype(jnp.float32),
+        (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )  # [h, bt]
     scores = scores * scale
     if ks is not None:
-        scores = scores * ks  # per-key int8 scale, factored out of the dot
+        scores = scores * ks  # per-key int8 k scale, factored out of the dot
     scores = scores + bias
-    m = jnp.max(scores, axis=0, keepdims=True)
-    p = jnp.exp(scores - m)  # [T, 1]
-    return p, jnp.sum(p, axis=0, keepdims=True)
+    # Tail mask: slots past the cache end exist only as block padding. Their
+    # memory is undefined (int8 garbage / non-finite scale garbage), so the
+    # score is REPLACED, not biased, and p is re-zeroed after the exp (a
+    # fully-masked row has m == MASK_VAL, where exp(MASK_VAL - m) == 1).
+    kpos = it * bt + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    in_range = kpos < T
+    scores = jnp.where(in_range, scores, MASK_VAL)
 
-
-def _kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref, *, scale):
-    q2 = q_ref[0, 0, :].reshape(-1, 1).astype(jnp.float32)         # [d, 1]
-    k = k_ref[0, :, 0, :].astype(jnp.float32)                      # [T, d]
-    ks = ks_ref[0, :, 0].reshape(-1, 1).astype(jnp.float32)        # [T, 1]
-    bias = bias_ref[0, :].reshape(-1, 1)                           # [T, 1]
-    p, s = _attend_rows(q2, k, bias, ks, scale)
-    vs = vs_ref[0, :, 0].reshape(-1, 1).astype(jnp.float32)
-    w = (p * vs) / s                                               # [T, 1]
-    v = v_ref[0, :, 0, :].astype(jnp.float32)                      # [T, d]
-    out = jax.lax.dot_general(
-        w, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [1, d]
-    o_ref[0, 0, :] = out[0, :].astype(o_ref.dtype)
-
-
-def _kernel_plain(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
-    q2 = q_ref[0, 0, :].reshape(-1, 1).astype(jnp.float32)
-    k = k_ref[0, :, 0, :].astype(jnp.float32)
-    bias = bias_ref[0, :].reshape(-1, 1)
-    p, s = _attend_rows(q2, k, bias, None, scale)
-    w = p / s
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
-    out = jax.lax.dot_general(
-        w, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(scores - m_cur)
+    p = jnp.where(in_range, p, 0.0)
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    if vs is not None:
+        # per-key int8 v scale, folded into the weights — zeroed on tail
+        # padding, where the scale memory is undefined (0 * NaN would
+        # poison the contraction that p's zeros alone cannot protect).
+        p = p * jnp.where(in_range, vs, 0.0)
+    # out[h, d] += sum_t p[h, t] * v[t, h, d]. Tail-padding v rows are
+    # undefined memory: zero them so they cannot reach the accumulator
+    # even multiplied by a zero weight.
+    t_valid = (
+        it * bt + jax.lax.broadcasted_iota(jnp.int32, (v.shape[0], 1, 1), 0) < T
     )
-    o_ref[0, 0, :] = out[0, :].astype(o_ref.dtype)
+    vf = jnp.where(t_valid, v.astype(jnp.float32), 0.0)
+    pv = jax.lax.dot_general(
+        p,
+        vf,
+        (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )  # [h, d]
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _finalize(o_ref, acc_ref, l_ref):
+    # l == 0 cannot happen for in-range keys (even fully-masked rows sum
+    # positive p), but guard the division like the flash kernel does.
+    l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+    o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, T, bt):
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, M_INIT)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    _decode_block(
+        q_ref[0].astype(jnp.float32),
+        k_ref[0],
+        v_ref[0],
+        ks_ref[0].astype(jnp.float32),
+        vs_ref[0].astype(jnp.float32),
+        bias_ref[0],
+        it,
+        acc_ref,
+        m_ref,
+        l_ref,
+        scale=scale,
+        T=T,
+        bt=bt,
+    )
+
+    @pl.when(it == nt - 1)
+    def _():
+        _finalize(o_ref, acc_ref, l_ref)
+
+
+def _kernel_plain(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, T, bt):
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, M_INIT)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    _decode_block(
+        q_ref[0].astype(jnp.float32),
+        k_ref[0],
+        v_ref[0],
+        None,
+        None,
+        bias_ref[0],
+        it,
+        acc_ref,
+        m_ref,
+        l_ref,
+        scale=scale,
+        T=T,
+        bt=bt,
+    )
+
+    @pl.when(it == nt - 1)
+    def _():
+        _finalize(o_ref, acc_ref, l_ref)
 
 
 def decode_attn_eligible(n_head: int, head_dim: int, cache_len: int, quant: bool) -> bool:
-    """Static routing: real TPU + tile-aligned shapes (int8 sublane tile is
-    32, bf16 16; lanes 128). Mirrors auto_flash_ok's spirit — off-TPU the
-    einsum path is faster than interpreted pallas."""
+    """Static routing: real TPU backend + a head layout the MXU/VPU tile
+    cleanly (the full-[h, d] blocks are tile-LEGAL for any shape; the gate
+    keeps sub-tile head layouts — tiny test models — on the einsum path
+    where they are faster). The masked tail block removed the old
+    `cache_len % sublane == 0` restriction: any cache length is eligible.
+    `cache_len`/`quant` stay in the signature as the routing key the
+    lowering probe is cached on."""
     if not _HAVE_PLTPU or jax.default_backend() != "tpu":
         return False
-    sublane = 32 if quant else 16
-    return head_dim % 128 == 0 and cache_len % sublane == 0
+    return head_dim % 128 == 0 and n_head % 8 == 0
 
 
-def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale, interpret=None):
-    """Single-token attention over the cache.
+_PROBE_CACHE = {}
+
+
+def decode_attn_supported(B: int, T: int, h: int, d: int, quant: bool, dtype=jnp.bfloat16) -> bool:
+    """One-time cached lowering probe: can THIS shape's kernel actually
+    lower? Two stages, both off the hot path (the result is cached per
+    shape key for the life of the process):
+
+    1. the CPU-runnable static tile check (tiling.check_layout over the
+       real block layouts) — catches any (8, 128) violation instantly;
+    2. on a real TPU backend, an abstract `jax.jit(...).lower()` of the
+       kernel call, which runs the genuine Mosaic block-mapping checks.
+
+    Any failure warns ONCE and answers False — the model layer then routes
+    the step through the einsum path instead of letting the ValueError
+    surface mid-bench from inside a compiled rollout program (the BENCH_r05
+    failure mode)."""
+    key = (B, T, h, d, bool(quant), jnp.dtype(dtype).name, jax.default_backend())
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    try:
+        from trlx_tpu.ops.tiling import check_layout, decode_block_layout
+
+        check_layout(decode_block_layout(B, T, h, d, bool(quant)))
+        if _HAVE_PLTPU and jax.default_backend() == "tpu":
+            s = jax.ShapeDtypeStruct
+            args = [s((B, h, d), dtype), s((B, T, h, d), jnp.int8 if quant else dtype)]
+            args.append(args[1])
+            if quant:
+                args += [s((B, T, h), jnp.float32)] * 2
+            else:
+                args += [None, None]
+            args.append(s((B, T), jnp.float32))
+
+            def probe(q, k, v, ks, vs, bias):
+                return decode_attention(q, k, v, ks, vs, bias, scale=1.0, interpret=False)
+
+            jax.jit(probe).lower(*args)
+        ok = True
+    except Exception as e:  # noqa: BLE001 — ANY probe failure must fall back
+        warnings.warn(
+            f"decode-attention kernel unavailable for shape [B={B}, T={T}, "
+            f"h={h}, d={d}, quant={quant}] — falling back to the einsum "
+            f"path ({type(e).__name__}: {str(e)[:300]})"
+        )
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale,
+                     interpret=None, block_t=None):
+    """Single-token flash-decode attention over the cache.
 
     q: [B, h, d] (this step's query). k_cache/v_cache: [B, T, h, d] — int8
     when ks/vs (per-slot scales [B, T, h]) are given, else the compute
     dtype. bias_row: [B, T] additive fp32 mask row (0 valid / -1e9 invalid —
     the einsum path's bias, one row). Returns [B, 1, h, d] in q.dtype."""
+    from trlx_tpu.ops.tiling import decode_block_layout
+
     B, h, d = q.shape
     T = k_cache.shape[1]
+    quant = ks is not None
     interpret = _interpret_default() if interpret is None else interpret
-    grid = (B, h)
-    q_spec = _vmem((1, 1, d), lambda b, j: (b, j, 0))
-    kv_spec = _vmem((1, T, 1, d), lambda b, j: (b, 0, j, 0))
-    sc_spec = _vmem((1, T, 1), lambda b, j: (b, 0, j))
-    bias_spec = _vmem((1, T), lambda b, j: (b, 0))
-    out_spec = _vmem((1, 1, d), lambda b, j: (b, j, 0))
+    bt = pick_t_block(T) if block_t is None else block_t
+    nt = -(-T // bt)
+    grid = (B, nt)
+
+    # The wrapper's operands and specs come from the SAME layout description
+    # the tiling validator checks (tiling.decode_block_layout).
+    layout = {
+        lay.name: lay for lay in decode_block_layout(B, T, h, d, quant, block_t=bt)
+    }
+    q_spec = _vmem(layout["q"].block_shape, lambda b, it: (b, 0, 0))
+    kv_spec = _vmem(layout["k_cache"].block_shape, lambda b, it: (b, it, 0, 0))
+    bias_spec = _vmem(layout["bias"].block_shape, lambda b, it: (b, 0, it))
+    out_spec = _vmem(layout["out"].block_shape, lambda b, it: (b, 0, 0))
     out_shape = jax.ShapeDtypeStruct((B, h, d), q.dtype)
-    if ks is not None:
+    scratch = [
+        _scratch((h, d)),    # fp32 output accumulator
+        _scratch((h, 128)),  # running max
+        _scratch((h, 128)),  # running sum
+    ]
+    bias3 = bias_row.astype(jnp.float32)[:, None, :]  # [B, 1, T]
+    common = dict(
+        grid=grid,
+        out_specs=out_spec,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )
+    if quant:
+        sc_spec = _vmem(layout["k_scale"].block_shape, lambda b, it: (b, 0, it))
+        # Head-major scales: [B, T, h] -> [B, h, T]. An XLA transpose of the
+        # fp32 scale planes (<1% of the int8 cache bytes) buys a kernel with
+        # no in-kernel transposes.
+        ks_t = jnp.swapaxes(ks, 1, 2)
+        vs_t = jnp.swapaxes(vs, 1, 2)
         out = pl.pallas_call(
-            functools.partial(_kernel_quant, scale=scale),
-            grid=grid,
+            functools.partial(_kernel_quant, scale=scale, T=T, bt=bt),
             in_specs=[q_spec, kv_spec, kv_spec, sc_spec, sc_spec, bias_spec],
-            out_specs=out_spec,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(q, k_cache, v_cache, ks, vs, bias_row)
+            **common,
+        )(q, k_cache, v_cache, ks_t, vs_t, bias3)
     else:
         out = pl.pallas_call(
-            functools.partial(_kernel_plain, scale=scale),
-            grid=grid,
+            functools.partial(_kernel_plain, scale=scale, T=T, bt=bt),
             in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
-            out_specs=out_spec,
-            out_shape=out_shape,
-            interpret=interpret,
-        )(q, k_cache, v_cache, bias_row)
+            **common,
+        )(q, k_cache, v_cache, bias3)
     return out[:, None]  # [B, 1, h, d]

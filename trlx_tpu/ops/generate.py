@@ -270,7 +270,20 @@ def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] 
         apply_kwargs=apply_kwargs,
         prefill_collect=prefill_collect,
     )
-    jitted = jax.jit(fn)
+
+    # Trace-count hook: the counter bumps INSIDE the traced body, so it
+    # increments exactly once per novel (batch, prompt_len) shape — a cached
+    # executable replays without re-tracing. This is how the bucketing tests
+    # (and operators reading metrics) verify that prompt bucketing bounds the
+    # number of compiled generate programs to the number of buckets.
+    _traces = {"n": 0, "shapes": []}
+
+    def traced(variables, prompt_ids, prompt_mask, rng):
+        _traces["n"] += 1
+        _traces["shapes"].append(tuple(prompt_ids.shape))
+        return fn(variables, prompt_ids, prompt_mask, rng)
+
+    jitted = jax.jit(traced)
 
     def call(variables, prompt_ids, prompt_mask, rng):
         current = mesh_mod.peek_mesh()
@@ -281,6 +294,11 @@ def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] 
                 "generate fn for the new mesh — the traced KV-cache sharding "
                 "would otherwise be stale."
             )
-        return jitted(variables, prompt_ids, prompt_mask, rng)
+        out = jitted(variables, prompt_ids, prompt_mask, rng)
+        call.num_traces = _traces["n"]
+        call.traced_shapes = tuple(_traces["shapes"])
+        return out
 
+    call.num_traces = 0
+    call.traced_shapes = ()
     return call

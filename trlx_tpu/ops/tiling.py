@@ -1,0 +1,139 @@
+"""Static Mosaic tile-legality validator for Pallas BlockSpecs.
+
+The Mosaic TPU lowering requires that the LAST TWO dimensions of every
+BlockSpec block shape are divisible by (8, 128) — or equal the respective
+dimensions of the overall array (a "full" block needs no tiling). Violations
+only surface at lowering time ON A TPU, as a mid-run ValueError: exactly how
+the old decode-attention kernel's per-head `(1, 1, d)` q block killed
+BENCH_r05 at the flagship size (rc=1, decode_attention.py:61).
+
+This module makes the rule checkable on CPU, without lowering anything:
+kernel modules describe their real block layouts (`decode_block_layout`,
+`flash_block_layout`) and tier-1 tests assert legality at the real bench
+shapes. The decode-attention runtime probe also runs `check_layout` first,
+so an illegal shape is refused (and routed to einsum) before any Mosaic
+lowering is attempted.
+"""
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+# The divisibility floor Mosaic enforces on the last two block dims (the
+# fp32 register tile). Per-dtype minimum tiles — bf16 (16, 128), int8
+# (32, 128) — affect layout efficiency, not lowering legality, so the
+# validator enforces (8, 128) and leaves dtype padding to the compiler.
+SUBLANE = 8
+LANE = 128
+
+
+class BlockLayout(NamedTuple):
+    """One operand's (block shape, array shape) pair, as handed to
+    pl.BlockSpec / pl.pallas_call."""
+
+    name: str
+    block_shape: Tuple[int, ...]
+    array_shape: Tuple[int, ...]
+
+
+class TileError(ValueError):
+    """A BlockSpec violates the Mosaic last-two-dims (8, 128)-or-full rule."""
+
+
+def block_tile_issues(
+    block_shape: Sequence[int],
+    array_shape: Sequence[int],
+    name: str = "operand",
+) -> list:
+    """All (8, 128)-or-full violations for one block spec, as strings.
+
+    Mirrors Mosaic's actual check: for arrays of rank >= 2, block dim -1
+    must be divisible by 128 or equal array dim -1, and block dim -2 must be
+    divisible by 8 or equal array dim -2. Rank-0/1 blocks are unconstrained
+    here (Mosaic handles them separately). Also flags blocks larger than the
+    array and rank mismatches, which can never map."""
+    issues = []
+    if len(block_shape) != len(array_shape):
+        return [
+            f"{name}: block rank {len(block_shape)} != array rank "
+            f"{len(array_shape)} (block {tuple(block_shape)} vs array "
+            f"{tuple(array_shape)})"
+        ]
+    for b, a in zip(block_shape, array_shape):
+        if b > a:
+            issues.append(
+                f"{name}: block dim {b} exceeds array dim {a} "
+                f"(block {tuple(block_shape)} vs array {tuple(array_shape)})"
+            )
+    if len(block_shape) < 2:
+        return issues
+    checks = ((-2, SUBLANE), (-1, LANE))
+    for axis, tile in checks:
+        b, a = block_shape[axis], array_shape[axis]
+        if b % tile != 0 and b != a:
+            issues.append(
+                f"{name}: block dim {axis} is {b} — must be divisible by "
+                f"{tile} or equal the array dim {a} (block "
+                f"{tuple(block_shape)} vs array {tuple(array_shape)}); "
+                "the Mosaic TPU lowering rejects this spec"
+            )
+    return issues
+
+
+def check_layout(layouts: Sequence[BlockLayout]) -> None:
+    """Raise TileError listing every violation across a kernel's specs."""
+    issues = []
+    for lay in layouts:
+        issues.extend(block_tile_issues(lay.block_shape, lay.array_shape, lay.name))
+    if issues:
+        raise TileError("; ".join(issues))
+
+
+def is_tile_legal(layouts: Sequence[BlockLayout]) -> bool:
+    try:
+        check_layout(layouts)
+        return True
+    except TileError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Layout descriptions of the in-tree kernels (one source of truth: the
+# kernel wrappers build their pallas specs FROM these, so the validator can
+# never drift from what actually lowers).
+# ---------------------------------------------------------------------------
+
+
+def decode_block_layout(
+    B: int, T: int, h: int, d: int, quant: bool, block_t: Optional[int] = None
+) -> list:
+    """The flash-decode kernel's block layouts at a given shape (see
+    trlx_tpu.ops.decode_attention: grid (batch, T-blocks), full [h, d]
+    blocks, scales pre-transposed to [B, h, T], bias as [B, 1, T])."""
+    from trlx_tpu.ops.decode_attention import pick_t_block
+
+    bt = pick_t_block(T) if block_t is None else block_t
+    layouts = [
+        BlockLayout("q", (1, h, d), (B, h, d)),
+        BlockLayout("k_cache", (1, bt, h, d), (B, T, h, d)),
+        BlockLayout("v_cache", (1, bt, h, d), (B, T, h, d)),
+        BlockLayout("bias", (1, 1, bt), (B, 1, T)),
+        BlockLayout("out", (1, h, d), (B, h, d)),
+    ]
+    if quant:
+        layouts[3:3] = [
+            BlockLayout("k_scale", (1, h, bt), (B, h, T)),
+            BlockLayout("v_scale", (1, h, bt), (B, h, T)),
+        ]
+    return layouts
+
+
+def flash_block_layout(BH: int, T: int, D: int, bq: int, bk: int) -> list:
+    """The flash-attention forward kernel's block layouts (see
+    trlx_tpu.ops.flash_attention._fwd)."""
+    return [
+        BlockLayout("kmask", (1, 1, bk), (BH, 1, T)),
+        BlockLayout("q", (1, bq, D), (BH, T, D)),
+        BlockLayout("k", (1, bk, D), (BH, T, D)),
+        BlockLayout("v", (1, bk, D), (BH, T, D)),
+        BlockLayout("o", (1, bq, D), (BH, T, D)),
+        BlockLayout("lse", (1, 1, bq), (BH, 1, T)),
+    ]

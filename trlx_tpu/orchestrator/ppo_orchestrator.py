@@ -118,6 +118,9 @@ class PPOOrchestrator(Orchestrator):
         # generate time that host work hides does NOT show up in gen_s — it
         # reports residual blocking, which is the honest pipelined cost.
         gen_s = reward_s = score_s = push_s = 0.0
+        gen_tokens = 0
+        decode_steps = []
+        step_budget = 0
         t = time.time()
         pending = self._generate_next_chunk()
         gen_s += time.time() - t
@@ -149,6 +152,10 @@ class PPOOrchestrator(Orchestrator):
             # reward paths and the store push reuse these host rows.
             tokens_h, mask_h = self.rl_model.to_local_host((tokens, mask))
             gen_s += time.time() - t
+            ds = self.rl_model.rollout_decode_stats(mask_h, P)
+            gen_tokens += ds["gen_tokens"]
+            decode_steps.append(ds["decode_steps"])
+            step_budget = ds["decode_step_budget"]
 
             if getattr(self.rl_model, "has_reward_model", False):
                 # On-device learned RM: the whole scoring pass (policy
@@ -194,10 +201,23 @@ class PPOOrchestrator(Orchestrator):
             )
             score_s += time.time() - t
             t = time.time()
+            # With prompt bucketing the chunks arrive at per-bucket widths P,
+            # but the rollout store fixes its query width on the FIRST push
+            # and the train step compiles at the single full prompt_length —
+            # so the query region is re-left-padded to the trainer's global
+            # width here, on the host, before storage. Pad rows are mask-0:
+            # the training forward sees exactly the tokens generation saw.
+            q_ids, q_mask = tokens_h[:, :P], mask_h[:, :P]
+            P_full = int(getattr(self.rl_model, "prompt_length", P))
+            if P < P_full:
+                pad_id = int(getattr(self.rl_model, "pad_token_id", 0))
+                pad = np.full((q_ids.shape[0], P_full - P), pad_id, dtype=np.asarray(q_ids).dtype)
+                q_ids = np.concatenate([pad, q_ids], axis=1)
+                q_mask = np.concatenate([np.zeros_like(pad), np.asarray(q_mask)], axis=1)
             self.rl_model.store.push_batch(
                 {
-                    "query_tensors": tokens_h[:, :P],
-                    "query_mask": mask_h[:, :P],
+                    "query_tensors": q_ids,
+                    "query_mask": q_mask,
                     "response_tensors": tokens_h[:, P:],
                     "response_mask": mask_h[:, P:],
                     "logprobs": logprobs,
@@ -219,6 +239,14 @@ class PPOOrchestrator(Orchestrator):
                 "exp_reward_s": reward_s,
                 "exp_score_s": score_s,
                 "exp_push_s": push_s,
+                # Decode-loop observability: generated tokens per second of
+                # generate-BLOCKED wall time (pipelining hides device time
+                # behind host work, so this is a lower bound on the device
+                # rate), and the per-chunk while_loop steps actually executed
+                # vs the max_new_tokens budget (early-exit savings).
+                "exp_decode_tokens_per_s": gen_tokens / max(gen_s, 1e-9),
+                "exp_decode_steps": float(np.mean(decode_steps)),
+                "exp_decode_step_budget": float(step_budget),
                 "rollout_mean_score": float(np.mean(scores)),
                 "rollout_mean_kl": float(np.mean(kl.sum(-1))),
             },

@@ -7,11 +7,28 @@ shape, left-padded (the decode engine samples at the last position), so the
 whole rollout path compiles exactly once.
 """
 
-from typing import Iterable
+from typing import Iterable, Optional, Sequence
 
 import numpy as np
 
-from trlx_tpu.pipeline import BasePipeline, BatchLoader, register_datapipeline
+from trlx_tpu.pipeline import (
+    BasePipeline,
+    BatchLoader,
+    BucketedBatchLoader,
+    register_datapipeline,
+)
+
+
+def normalize_buckets(widths: Optional[Sequence[int]], max_width: int):
+    """Sorted, deduplicated bucket widths clamped to (0, max_width], with
+    max_width always present as the terminal bucket. Returns None for a
+    None/empty input (bucketing off)."""
+    if not widths:
+        return None
+    ws = sorted({int(w) for w in widths if 0 < int(w) <= max_width})
+    if not ws or ws[-1] != max_width:
+        ws.append(max_width)
+    return tuple(ws)
 
 
 @register_datapipeline
@@ -24,11 +41,21 @@ class PromptPipeline(BasePipeline):
     :param tokenizer: HF tokenizer or None.
     :param max_prompt_length: static prompt length; longer prompts truncate
         from the LEFT (keep the most recent context), shorter ones left-pad.
+    :param bucket_widths: optional prompt-length buckets. When set, each
+        prompt is padded only to the SMALLEST bucket width that fits it
+        (instead of all the way to max_prompt_length), and `create_loader`
+        returns a BucketedBatchLoader whose batches are bucket-uniform — the
+        rollout generate program then compiles once per bucket, and short
+        prompts stop paying prefill + per-step attention over pad keys.
+        Normalized via `normalize_buckets` (max_prompt_length is always the
+        terminal bucket). `__getitem__` and the max-width arrays keep the
+        original single-width behavior for non-bucketed consumers.
     """
 
-    def __init__(self, prompts: Iterable, tokenizer=None, max_prompt_length: int = 64, add_bos: bool = True):
+    def __init__(self, prompts: Iterable, tokenizer=None, max_prompt_length: int = 64, add_bos: bool = True, bucket_widths: Optional[Sequence[int]] = None):
         self.tokenizer = tokenizer
         self.max_prompt_length = max_prompt_length
+        self.bucket_widths = normalize_buckets(bucket_widths, max_prompt_length)
 
         if tokenizer is not None:
             # BOS prepended like the reference's tokenize()
@@ -52,13 +79,51 @@ class PromptPipeline(BasePipeline):
         )
         self.pad_id = pad_id
 
+        # Bucketed views: per bucket width, the member rows re-padded to that
+        # width. Built once at construction (prompt sets are small next to
+        # the KV caches they feed) from the same pad_ragged path, so the
+        # left-pad/keep-last semantics are identical per bucket.
+        self._bucket_rows = {}
+        self._bucket_ids = {}
+        self._bucket_mask = {}
+        if self.bucket_widths is not None:
+            lengths = [min(len(t), max_prompt_length) for t in token_lists]
+            target = {
+                i: next(w for w in self.bucket_widths if w >= n)
+                for i, n in enumerate(lengths)
+            }
+            for w in self.bucket_widths:
+                rows = np.asarray([i for i in range(len(token_lists)) if target[i] == w], dtype=np.int64)
+                if len(rows) == 0:
+                    continue
+                ids, msk = pad_ragged(
+                    [token_lists[i] for i in rows], w, pad_id, left_pad=True, keep_last=True
+                )
+                self._bucket_rows[w] = rows
+                self._bucket_ids[w] = ids
+                self._bucket_mask[w] = msk
+
     def __len__(self) -> int:
         return self.input_ids.shape[0]
 
     def __getitem__(self, ix: int):
         return {"input_ids": self.input_ids[ix], "attention_mask": self.attention_mask[ix]}
 
-    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0) -> BatchLoader:
+    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0):
+        if self.bucket_widths is not None:
+            # Bucket-local indices: collate slices the per-width arrays, so a
+            # batch's shape is its bucket's [batch_size, width].
+            def bucket_collate(width, ixs):
+                return {
+                    "input_ids": self._bucket_ids[width][ixs],
+                    "attention_mask": self._bucket_mask[width][ixs],
+                }
+
+            buckets = {w: np.arange(len(rows)) for w, rows in self._bucket_rows.items()}
+            return BucketedBatchLoader(
+                buckets, batch_size, bucket_collate, shuffle=shuffle, drop_last=drop_last, seed=seed
+            )
+
         def collate(ixs):
             return {
                 "input_ids": self.input_ids[ixs],

@@ -74,7 +74,16 @@ def train(
             assert model.tokenizer is not None, "default prompts need a tokenizer"
             prompts = [model.tokenizer.bos_token] * batch_size
 
-        pipeline = PromptPipeline(prompts, model.tokenizer, max_prompt_length=model.prompt_length)
+        # prompt_buckets (method.gen_kwargs) flows trainer → pipeline: the
+        # rollout loader then yields bucket-uniform batches, padded only to
+        # the bucket width, and the trainer keys compiled generate/score
+        # programs per bucket. The eval pipeline stays single-width.
+        pipeline = PromptPipeline(
+            prompts,
+            model.tokenizer,
+            max_prompt_length=model.prompt_length,
+            bucket_widths=getattr(model, "prompt_buckets", None),
+        )
         orch = get_orchestrator(config.train.orchestrator)(
             model, pipeline, reward_fn=reward_fn, metric_fn=metric_fn, chunk_size=config.method.chunk_size
         )

@@ -363,6 +363,20 @@ class JaxBaseTrainer(BaseRLTrainer):
         mask = self.to_local_host(mask)
         return [t[m.astype(bool)] for t, m in zip(tokens, mask)]
 
+    @staticmethod
+    def rollout_decode_stats(mask_h, prompt_length: int):
+        """Decode-loop observability for one rollout chunk, from the HOST
+        mask: generated-token count (mask-valid response positions) and the
+        number of decode steps the while_loop actually executed — the highest
+        response position any row was still live at, which is what the
+        early-exit decode pays for (vs the max_new_tokens budget)."""
+        resp = np.asarray(mask_h)[:, prompt_length:]
+        return {
+            "gen_tokens": int(resp.sum()),
+            "decode_steps": int(resp.any(axis=0).sum()),
+            "decode_step_budget": int(resp.shape[1]),
+        }
+
     def next_rng(self):
         self.rng, sub = jax.random.split(self.rng)
         return sub

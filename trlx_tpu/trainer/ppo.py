@@ -84,6 +84,18 @@ class PPOTrainer(JaxBaseTrainer):
             config.train.seq_length - int(gen_kwargs.get("max_new_tokens", config.train.seq_length // 2)),
             1,
         )
+        # Prompt-length bucketing (method.gen_kwargs["prompt_buckets"]): the
+        # prompt pipeline pads each prompt to the smallest listed width that
+        # fits instead of always to prompt_length. Rollout generation/scoring
+        # then compile once per bucket (jit keys on the prompt width) while
+        # the stored experience — and therefore the train step — stays at the
+        # single prompt_length width (the orchestrator re-pads queries before
+        # the store push). None = off, single-width behavior.
+        from trlx_tpu.pipeline.prompt_pipeline import normalize_buckets
+
+        self.prompt_buckets = normalize_buckets(
+            gen_kwargs.pop("prompt_buckets", None), self.prompt_length
+        )
         self.gen_cfg = GenerateConfig.from_gen_kwargs(
             gen_kwargs,
             prompt_len=self.prompt_length,
@@ -106,7 +118,13 @@ class PPOTrainer(JaxBaseTrainer):
                 return process_logits_default(bigram(logits, state), gcfg, state["step"])
 
         self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
-        self._score_fn = jax.jit(partial(self._rollout_score_impl, prompt_length=self.prompt_length))
+        # Rollout scoring compiles per prompt width: prompt_length is a
+        # STATIC argument (it sets slice boundaries inside the program), so
+        # bucketed rollouts key a dict of jitted score fns by P — at most one
+        # per bucket, resolved from the incoming batch width in rollout_score*.
+        self._score_fns = {}
+        self._score_fused_fns = {}
+        self._score_rm_fns = {}
 
         # W8A16 decode: int8 copies of the trunk matmul kernels ride along as
         # the 'qw' variable collection; QDense reads them instead of the bf16
@@ -174,9 +192,6 @@ class PPOTrainer(JaxBaseTrainer):
                 apply_kwargs={"collect_branch_hidden": True},
                 prefill_collect=("branch_hidden",),
             )
-            self._score_fused_fn = jax.jit(
-                partial(self._rollout_score_fused_impl, prompt_length=self.prompt_length)
-            )
 
         # On-device learned reward model: a second LM + scalar head, sharded
         # with the SAME partition rules as the policy and scored inside the
@@ -189,9 +204,6 @@ class PPOTrainer(JaxBaseTrainer):
             from trlx_tpu.parallel import shard_pytree
 
             self.rm_params, _ = shard_pytree(rm_host_params, self.mesh)
-            self._score_rm_fn = jax.jit(
-                partial(self._rollout_score_rm_impl, prompt_length=self.prompt_length)
-            )
             self._rm_eval_fn = jax.jit(self._rm_scores)
 
         self.train_step = self.build_train_step()
@@ -272,7 +284,7 @@ class PPOTrainer(JaxBaseTrainer):
         """Fused rollout scoring with the ON-DEVICE reward model: policy
         logprobs + values + hydra ref KL + RM scores in one program — no
         decode, no host boundary."""
-        return self._score_rm_fn(
+        return self._score_rm_fn_for(self._batch_prompt_length(tokens))(
             self.state.params,
             self.state.extras,
             self.rm_params,
@@ -310,6 +322,33 @@ class PPOTrainer(JaxBaseTrainer):
         before every rollout phase so the sampler never lags the optimizer."""
         if self._qw is not None:
             self._qw = self._quantize_fn(self.state.params)
+
+    def _batch_prompt_length(self, tokens) -> int:
+        """The prompt width of a rollout batch: total width minus the (fixed)
+        response length. With bucketing this varies per batch; without, it is
+        always self.prompt_length."""
+        return int(tokens.shape[1]) - self.response_length
+
+    def _score_fn_for(self, P: int):
+        fn = self._score_fns.get(P)
+        if fn is None:
+            fn = jax.jit(partial(self._rollout_score_impl, prompt_length=P))
+            self._score_fns[P] = fn
+        return fn
+
+    def _score_fused_fn_for(self, P: int):
+        fn = self._score_fused_fns.get(P)
+        if fn is None:
+            fn = jax.jit(partial(self._rollout_score_fused_impl, prompt_length=P))
+            self._score_fused_fns[P] = fn
+        return fn
+
+    def _score_rm_fn_for(self, P: int):
+        fn = self._score_rm_fns.get(P)
+        if fn is None:
+            fn = jax.jit(partial(self._rollout_score_rm_impl, prompt_length=P))
+            self._score_rm_fns[P] = fn
+        return fn
 
     def rollout_generate(self, input_ids, attention_mask):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
@@ -352,7 +391,7 @@ class PPOTrainer(JaxBaseTrainer):
     def rollout_score_fused(self, tokens, mask, scores, gen_aux):
         stats, prefill_extras = gen_aux
         scores = self.put_batch(np.asarray(scores, dtype=np.float32))
-        return self._score_fused_fn(
+        return self._score_fused_fn_for(self._batch_prompt_length(tokens))(
             self.state.extras,
             tokens,
             mask,
@@ -393,7 +432,7 @@ class PPOTrainer(JaxBaseTrainer):
 
     def rollout_score(self, tokens, mask, scores):
         scores = self.put_batch(np.asarray(scores, dtype=np.float32))
-        return self._score_fn(
+        return self._score_fn_for(self._batch_prompt_length(tokens))(
             self.state.params,
             self.state.extras,
             tokens,
