@@ -13,6 +13,12 @@ Two probes covering exactly what BENCH_r05 showed CPU CI was blind to:
    <= n_buckets (the trace-count hook) and the decode metrics helper returns
    sane numbers.
 
+3. overlap — a tiny bucketed PPO run with the rollout/train pipeline on
+   (method.max_staleness=1): the phase windows in metrics.jsonl must carry
+   time/overlap_fraction, the stored samples must carry the staleness
+   column, and the producer/score-worker threads must be joined by the time
+   train() returns.
+
 Writes BENCH_SMOKE.json and prints one JSON summary line; exits 1 on any
 failure. Wall time ~1-2 min on a laptop CPU.
 """
@@ -124,9 +130,65 @@ def rollout_probe():
     }
 
 
+def overlap_probe():
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    import trlx_tpu
+    from randomwalks import base_config, generate_random_walks
+
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 16
+    config.train.epochs = 8
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.method.num_rollouts = 32
+    config.method.chunk_size = 16
+    config.method.max_staleness = 1
+    config.method.gen_kwargs["prompt_buckets"] = [1]
+    d = tempfile.mkdtemp(prefix="overlap_smoke_")
+    config.train.checkpoint_dir = d
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    t0 = time.time()
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    wall_s = time.time() - t0
+
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    fractions = [r["time/overlap_fraction"] for r in records if "time/overlap_fraction" in r]
+    assert fractions, "no phase windows reached metrics.jsonl"
+    stale = [r["staleness/mean"] for r in records if "staleness/mean" in r]
+    assert stale and stale[-1] == 1.0, f"staleness stats missing/wrong: {stale}"
+    # the producer joined cleanly: no pipeline thread outlives train()
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("trlx-")]
+    assert not leaked, f"pipeline threads leaked: {leaked}"
+    assert model._rollout_producer is None
+    return {
+        "steps": model.iter_count,
+        "overlap_fraction_max": round(max(fractions), 3),
+        "windows": len(fractions),
+        "staleness_last": stale[-1],
+        "seconds": round(wall_s, 2),
+    }
+
+
 def main():
     t0 = time.time()
-    result = {"kernel": kernel_probe(), "rollout": rollout_probe()}
+    result = {"kernel": kernel_probe(), "rollout": rollout_probe(), "overlap": overlap_probe()}
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)

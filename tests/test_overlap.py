@@ -1,0 +1,395 @@
+"""Overlapped rollout/train pipeline (trlx_tpu/pipeline/overlap.py).
+
+Unit tier: the threading primitives (PrefetchIterator, ScoreWorker,
+RolloutProducer staleness gate, PhaseTimer) plus the staleness column in the
+rollout store. Integration tier (still fast, CPU): the acceptance identity —
+a full PPO run with the pipeline on at max_staleness=0 produces the
+bitwise-identical loss trajectory to the serial schedule — and the
+reward_hang fault drill through the background score worker.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.pipeline.overlap import (  # noqa: E402
+    PhaseTimer,
+    PrefetchIterator,
+    RolloutProducer,
+    ScoreWorker,
+    SerialFeed,
+)
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage  # noqa: E402
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ------------------------------------------------------------- batch prefetch
+
+
+def test_prefetch_iterator_ordering_and_exhaustion():
+    feed = PrefetchIterator(range(10), transform=lambda x: x * 2, depth=3)
+    assert list(feed) == [x * 2 for x in range(10)]
+    # exhaustion is sticky — the epoch loop may probe again
+    with pytest.raises(StopIteration):
+        next(feed)
+    with pytest.raises(StopIteration):
+        next(feed)
+    feed.close()  # idempotent after exhaustion
+
+
+def test_prefetch_iterator_transform_error_reraises_in_order():
+    def transform(x):
+        if x == 3:
+            raise RuntimeError("boom at 3")
+        return x
+
+    feed = PrefetchIterator(range(6), transform=transform, depth=2)
+    assert [next(feed), next(feed), next(feed)] == [0, 1, 2]
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        while True:
+            next(feed)
+    feed.close()
+
+
+def test_prefetch_iterator_close_unblocks_full_queue():
+    # Consumer abandons mid-epoch (the preemption return path) while the
+    # worker is parked on a full queue: close() must unblock and join it.
+    feed = PrefetchIterator(range(1000), depth=1)
+    assert next(feed) == 0
+    feed.close()
+    assert not feed._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(feed)
+
+
+def test_serial_feed_is_lazy_and_inline():
+    calls = []
+
+    def transform(x):
+        calls.append(x)
+        return x + 1
+
+    feed = SerialFeed([1, 2, 3], transform=transform)
+    assert calls == []  # nothing runs ahead of the consumer
+    assert next(feed) == 2
+    assert calls == [1]
+    assert list(feed) == [3, 4]
+    feed.close()
+
+
+# --------------------------------------------------------------- score worker
+
+
+def test_score_worker_fifo_results_and_busy_accounting():
+    def fn(x):
+        time.sleep(0.01)
+        return x * 10
+
+    w = ScoreWorker(fn, depth=2)
+    for i in range(5):
+        w.submit(i)
+    assert [w.result(timeout=10) for _ in range(5)] == [0, 10, 20, 30, 40]
+    w.close()
+    assert not w.alive
+    assert w.busy_s > 0.0
+
+
+def test_score_worker_error_propagates_and_close_never_deadlocks():
+    def fn(x):
+        if x == 1:
+            raise ValueError("bad chunk")
+        return x
+
+    w = ScoreWorker(fn, depth=2)
+    w.submit(0)
+    w.submit(1)
+    w.submit(2)  # queued BEHIND the failure — still drains on close
+    assert w.result(timeout=10) == 0
+    with pytest.raises(ValueError, match="bad chunk"):
+        w.result(timeout=10)
+    w.close()
+    assert not w.alive
+
+
+# ---------------------------------------------------------------- phase timer
+
+
+def test_phase_timer_window_keys_and_overlap_fraction():
+    timer = PhaseTimer()
+    # Synthetic phase seconds far exceeding the real wall → high overlap.
+    timer.add("rollout", 1.0)
+    timer.add("score", 1.0)
+    with timer.timed("train"):
+        time.sleep(0.01)
+    w = timer.window()
+    for k in ("time/rollout_s", "time/score_s", "time/train_s", "time/window_wall_s", "time/overlap_fraction"):
+        assert k in w
+    assert w["time/rollout_s"] == pytest.approx(1.0)
+    assert 0.0 < w["time/overlap_fraction"] <= 1.0
+    # a drained window reads serial/empty
+    w2 = timer.window()
+    assert w2["time/rollout_s"] == 0.0
+    assert w2["time/overlap_fraction"] == 0.0
+
+
+def test_phase_timer_serial_phases_report_no_overlap():
+    timer = PhaseTimer()
+    with timer.timed("rollout"):
+        time.sleep(0.02)
+    with timer.timed("train"):
+        time.sleep(0.02)
+    w = timer.window()
+    # back-to-back phases cannot sum past the wall
+    assert w["time/overlap_fraction"] == pytest.approx(0.0, abs=0.05)
+
+
+# ----------------------------------------------------------- rollout producer
+
+
+def _producer(max_staleness, log, chunk_sleep=0.0):
+    def produce(store, index, snapshot, staleness, stop):
+        if chunk_sleep:
+            for _ in range(50):
+                if stop():
+                    return
+                time.sleep(chunk_sleep / 50)
+        log.append((index, staleness, snapshot))
+        store.append(index)
+
+    return RolloutProducer(produce, new_store=list, max_staleness=max_staleness)
+
+
+def test_producer_staleness_zero_blocks_until_consume():
+    log = []
+    p = _producer(0, log).start()
+    try:
+        # gate: index 1 - consumed 0 = 1 > 0 — nothing may produce yet
+        time.sleep(0.3)
+        assert log == [] and p.pending == 0
+        p.consume_done()
+        store = p.next_store(timeout=10)
+        assert store == [1]
+        assert log[0][:2] == (1, 0)  # staleness 0: fully on-policy
+        # and the NEXT store is gated again
+        time.sleep(0.3)
+        assert len(log) == 1
+    finally:
+        p.shutdown()
+    assert not p.alive
+
+
+def test_producer_staleness_one_runs_ahead_and_records_staleness():
+    log = []
+    p = _producer(1, log).start(snapshot="snap0")
+    try:
+        # runs ahead immediately: store 1 off the initial snapshot
+        assert wait_until(lambda: p.pending == 1)
+        assert log[0] == (1, 1, "snap0")
+        # ...but store 2 is gated (2 - 0 > 1)
+        time.sleep(0.3)
+        assert len(log) == 1
+        p.consume_done(snapshot="snap1")
+        assert p.next_store(timeout=10) == [1]
+        assert wait_until(lambda: len(log) == 2)
+        assert log[1] == (2, 1, "snap1")  # new boundary snapshot picked up
+    finally:
+        p.shutdown()
+
+
+def test_producer_error_reraises_from_next_store():
+    err = RuntimeError("producer died")
+
+    def produce(store, index, snapshot, staleness, stop):
+        raise err
+
+    p = RolloutProducer(produce, new_store=list, max_staleness=0).start()
+    p.consume_done()
+    with pytest.raises(RuntimeError) as ei:
+        p.next_store(timeout=10)
+    assert ei.value is err
+    p.shutdown()
+
+
+def test_producer_shutdown_drains_mid_phase():
+    log = []
+    p = _producer(1, log, chunk_sleep=30.0).start()
+    assert wait_until(lambda: p.alive)
+    t0 = time.time()
+    p.shutdown(timeout=30)
+    # the stop poll fires between chunks — seconds, not the 30s phase
+    assert time.time() - t0 < 10
+    assert not p.alive
+    assert p.pending == 0  # the partial store was dropped
+
+
+# ------------------------------------------------------- store staleness column
+
+
+def _rows(n, val=0.0, staleness=None):
+    rows = {
+        "query_tensors": np.ones((n, 3), np.int32),
+        "query_mask": np.ones((n, 3), np.int32),
+        "response_tensors": np.ones((n, 5), np.int32),
+        "response_mask": np.ones((n, 5), np.int32),
+        "logprobs": np.full((n, 5), val, np.float32),
+        "values": np.zeros((n, 5), np.float32),
+        "rewards": np.zeros((n, 5), np.float32),
+    }
+    if staleness is not None:
+        rows["staleness"] = np.full((n, 1), staleness, np.float32)
+    return rows
+
+
+def test_store_staleness_column_surfaces_in_batch_extras():
+    store = PPORolloutStorage(pad_token_id=0, record_staleness=True)
+    store.push_batch(_rows(8))  # producer omitted the column → zeros
+    store.push_batch(_rows(8, staleness=2.0))
+    loader = store.create_loader(16, shuffle=False)
+    batch = next(iter(loader))
+    assert batch.extras is not None
+    st = np.asarray(batch.extras["staleness"])
+    assert st.shape == (16,)
+    assert st[:8].tolist() == [0.0] * 8
+    assert st[8:].tolist() == [2.0] * 8
+
+
+def test_store_without_staleness_keeps_serial_layout():
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push_batch(_rows(8))
+    batch = next(iter(store.create_loader(8, shuffle=False)))
+    assert batch.extras is None
+
+
+# ------------------------------------------------------------ e2e acceptance
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def _run_ppo(task, ckpt_dir, **method_overrides):
+    _, logit_mask, metric_fn, reward_fn = task
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(ckpt_dir)
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    for k, v in method_overrides.items():
+        setattr(config.method, k, v)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    with open(os.path.join(str(ckpt_dir), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    return model, records
+
+
+def test_overlap_at_staleness_zero_matches_serial_exactly(task, tmp_path):
+    """The acceptance identity: rollout_overlap=True at max_staleness=0 runs
+    the producer + score worker + prefetch machinery yet yields the
+    BITWISE-identical loss trajectory — same rollouts in the same order, same
+    reward-call numbering, same RNG stream, same device programs."""
+    _, serial = _run_ppo(task, tmp_path / "serial")
+    model, overlap = _run_ppo(task, tmp_path / "overlap", rollout_overlap=True)
+
+    losses_serial = [r["loss"] for r in serial if "loss" in r]
+    losses_overlap = [r["loss"] for r in overlap if "loss" in r]
+    assert len(losses_serial) == 8
+    assert losses_overlap == losses_serial
+
+    # pipeline machinery ran and tore down cleanly
+    assert model._rollout_producer is None
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+    # phase windows flowed to metrics.jsonl
+    assert any("time/overlap_fraction" in r for r in overlap)
+    assert any("time/rollout_s" in r for r in overlap)
+    # per-sample staleness stats surface at log boundaries, all on-policy
+    stale = [r["staleness/mean"] for r in overlap if "staleness/mean" in r]
+    assert stale and all(s == 0.0 for s in stale)
+    # the serial run carries NO pipeline artifacts (byte-compatible default)
+    assert not any("staleness/mean" in r for r in serial)
+
+
+def test_max_staleness_one_trains_and_reports_staleness(task, tmp_path):
+    model, records = _run_ppo(task, tmp_path / "stale", max_staleness=1)
+    assert model.iter_count >= 8
+    stale = [r["staleness/mean"] for r in records if "staleness/mean" in r]
+    # iteration 0's store is on-policy; every later batch is 1 stale
+    assert stale and stale[0] == 0.0 and stale[-1] == 1.0
+    assert any("time/overlap_fraction" in r for r in records)
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------- fault drill
+
+
+def test_reward_hang_inside_score_worker_drains_cleanly(task, tmp_path, monkeypatch):
+    """TRLX_TPU_FAULTS=reward_hang through the BACKGROUND scorer: the
+    retry/timeout wrapper fires on the worker thread, the error re-raises on
+    the make_experience thread, and the pipeline tears down without a
+    deadlock or a leaked worker."""
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+    from trlx_tpu.trainer.ppo import PPOTrainer
+
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "reward_hang@1")
+    _, logit_mask, metric_fn, reward_fn = task
+    config = base_config("ppo", 15, 8)
+    config.train.checkpoint_dir = str(tmp_path / "ck")
+    config.train.batch_size = 16
+    config.train.reward_fn_timeout = 0.2
+    config.train.reward_fn_retries = 0
+    config.train.reward_fn_backoff = 0.0
+    config.method.num_rollouts = 32
+    config.method.chunk_size = 16
+    config.method.rollout_overlap = True
+    trainer = PPOTrainer(config, reward_fn=reward_fn, metric_fn=metric_fn, logit_mask=logit_mask)
+    assert trainer.overlap_rollouts
+
+    pipeline = PromptPipeline([[1]] * 32, tokenizer=None, max_prompt_length=1)
+    orch = PPOOrchestrator(trainer, pipeline, reward_fn, chunk_size=16)
+    with pytest.raises(TimeoutError, match="still running"):
+        orch.make_experience(num_rollouts=32)
+    # worker joined on the error path — nothing left to wedge shutdown
+    assert not any(t.name == "trlx-score-worker" for t in threading.enumerate())
+
+    # with retries restored the SAME injected hang is absorbed
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "reward_hang@3")
+    from trlx_tpu.resilience import FaultPlan
+
+    trainer.fault_plan = FaultPlan.from_env_or_config("")
+    trainer.config.train.reward_fn_retries = 2
+    store = PPORolloutStorage(pad_token_id=trainer.pad_token_id, record_staleness=True)
+    orch.make_experience(num_rollouts=32, store=store, staleness=1)
+    assert len(store) == 32
+    assert all(f.fired for f in trainer.fault_plan.faults)
+    g = store._buffer.gather(np.arange(32))
+    assert np.all(g["staleness"] == 1.0)
+    assert not any(t.name == "trlx-score-worker" for t in threading.enumerate())

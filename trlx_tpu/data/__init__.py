@@ -108,6 +108,10 @@ class PPORLBatch:
        reference infers masks as tokens != pad_id
        (trlx/model/accelerate_ppo_model.py:104-108), which mis-masks BOS when
        bos == eos == pad (gpt2). Explicit masks are also shape-static.
+    extras: optional HOST-side per-sample metadata (e.g. the staleness column
+       recorded by the pipelined rollout producer). The trainer splits it off
+       before put_batch — it never rides to device or into the jitted step's
+       pytree (None, the default, flattens to zero leaves).
     """
 
     query_tensors: Any
@@ -117,6 +121,7 @@ class PPORLBatch:
     rewards: Any
     response_mask: Any = None
     query_mask: Any = None
+    extras: Any = None
 
 
 @_register_pytree

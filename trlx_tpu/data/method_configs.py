@@ -80,6 +80,28 @@ class PPOConfig(MethodConfig):
     # ref branch. Engages when the hydra branch exists (num_layers_unfrozen
     # in (0, n_layer)) and no on-device RM is configured.
     fused_rollout_stats: bool = True
+    # Pipelined experience (trlx_tpu/pipeline/overlap.py). All four knobs
+    # default to the serial schedule — no threads, no double-buffering —
+    # unless rollout_overlap is set or max_staleness > 0.
+    #
+    # max_staleness: how many training iterations ahead the background
+    # rollout producer may run. 0 keeps today's fully-on-policy schedule
+    # (production of iteration n starts only after n-1 is fully trained on,
+    # so results are bitwise-identical to serial); S >= 1 lets generation of
+    # iteration n overlap training of iterations n-S..n-1 off a boundary
+    # param snapshot, with per-sample staleness recorded in the store.
+    max_staleness: int = 0
+    # rollout_overlap: turn the pipeline machinery on at max_staleness=0 —
+    # background reward scoring + producer thread + device batch prefetch,
+    # without relaxing the on-policy schedule.
+    rollout_overlap: bool = False
+    # score_queue_depth: max rollout chunks queued decoded-but-unscored for
+    # the background reward worker (backpressure bound on host memory).
+    score_queue_depth: int = 2
+    # prefetch_depth: how many train batches the epoch loop's PrefetchIterator
+    # stages on device ahead of the running train step (when the pipeline is
+    # enabled).
+    prefetch_depth: int = 1
 
 
 @dataclass

@@ -20,11 +20,13 @@ read) — the reference serializes these phases.
 """
 
 import time
+from collections import deque
 
 import jax
 import numpy as np
 
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.pipeline.overlap import ScoreWorker
 from trlx_tpu.resilience.faults import FaultInjected
 from trlx_tpu.resilience.retry import call_with_retries
 from trlx_tpu.utils import Clock
@@ -77,9 +79,11 @@ class PPOOrchestrator(Orchestrator):
             description="reward_fn",
         )
 
-    def _generate_next_chunk(self, fused=None):
+    def _generate_next_chunk(self, fused=None, snapshot=None):
         """`fused=None` follows the trainer's fused_rollout setting; False
-        forces the plain generate+recompute path (benchmark baselines)."""
+        forces the plain generate+recompute path (benchmark baselines).
+        `snapshot` routes generation through a boundary param snapshot
+        instead of the live (donated) TrainState — the staleness>0 producer."""
         try:
             batch = next(self.pipeline_iterator)
         except StopIteration:
@@ -94,23 +98,54 @@ class PPOOrchestrator(Orchestrator):
         # scorer needs (aux), so scoring is a ref-branch replay only.
         if fused:
             tokens, mask, stats, prefill = self.rl_model.rollout_generate_fused(
-                batch["input_ids"], batch["attention_mask"]
+                batch["input_ids"], batch["attention_mask"], snapshot=snapshot
             )
             return tokens, mask, P, (stats, prefill)
-        tokens, mask = self.rl_model.rollout_generate(batch["input_ids"], batch["attention_mask"])
+        tokens, mask = self.rl_model.rollout_generate(
+            batch["input_ids"], batch["attention_mask"], snapshot=snapshot
+        )
         return tokens, mask, P, None
 
-    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
-        """Fill the trainer's rollout store with `num_rollouts` rollout rows
+    def make_experience(
+        self,
+        num_rollouts: int = 1024,
+        iter_count: int = 0,
+        store=None,
+        snapshot=None,
+        staleness: int = 0,
+        stop=None,
+    ):
+        """Fill a rollout store with `num_rollouts` rollout rows
         (reference: trlx/orchestrator/ppo_orchestrator.py:50-130).
 
-        PIPELINED: the next chunk's generation is dispatched to the device
-        BEFORE the current chunk crosses the host boundary (decode +
-        reward_fn), so the TPU decodes chunk i+1 while the host scores chunk
-        i — the rollout/overlap idea of the pipeline-RLHF line of work
-        (PAPERS.md), which the reference serializes. Rows are pushed as whole
-        chunks into the native column store (trlx_tpu/native/collate.cpp) —
-        no per-sample Python objects."""
+        PIPELINED at three depths:
+
+        1. Always: the next chunk's generation is dispatched to the device
+           BEFORE the current chunk crosses the host boundary (decode +
+           reward_fn), so the TPU decodes chunk i+1 while the host scores
+           chunk i — JAX async dispatch, no threads.
+        2. ``rl_model.overlap_rollouts``: host scoring moves onto a single
+           FIFO ScoreWorker thread, so the MAIN thread keeps dispatching /
+           pulling device chunks while the worker runs decode + reward_fn —
+           the rollout/overlap idea of the pipeline-RLHF line of work
+           (PAPERS.md). FIFO preserves the serial path's reward-call order
+           and store push order exactly.
+        3. The RolloutProducer calls this with an explicit ``store`` (a fresh
+           double buffer), a boundary param ``snapshot`` (staleness>0: the
+           live TrainState is donated mid-train), the store's ``staleness``
+           for the per-sample column, and a ``stop`` poll so shutdown drains
+           between chunks.
+
+        Rows are pushed as whole chunks into the native column store
+        (trlx_tpu/native/collate.cpp) — no per-sample Python objects."""
+        rl = self.rl_model
+        store = store if store is not None else rl.store
+        record_staleness = bool(getattr(store, "record_staleness", False))
+        timer = getattr(rl, "_phase_timer", None)
+        use_worker = bool(getattr(rl, "overlap_rollouts", False)) and not getattr(
+            rl, "has_reward_model", False
+        )
+
         n_collected = 0
         clock = Clock()
         # Per-phase accounting (head-to-head attribution): generate-blocked,
@@ -121,86 +156,16 @@ class PPOOrchestrator(Orchestrator):
         gen_tokens = 0
         decode_steps = []
         step_budget = 0
-        t = time.time()
-        pending = self._generate_next_chunk()
-        gen_s += time.time() - t
-        heartbeat = getattr(self.rl_model, "heartbeat", None)
-        while True:
-            if heartbeat is not None:
-                # Rollout progress stamp: without it, a long experience phase
-                # looks identical to a wedged host in the stall report — the
-                # phase tag tells the CollectiveTimeout diagnostic this host
-                # was generating, not stuck.
-                heartbeat.beat(step=iter_count, phase="rollout")
-            tokens, mask, P, gen_aux = pending
-            # Rows THIS process will store (num_rollouts is per-process, the
-            # reference's per-rank semantics). Static shape — no device sync.
-            n_proc = jax.process_count()
-            if int(tokens.shape[0]) % n_proc != 0 or int(tokens.shape[0]) < n_proc:
-                raise ValueError(
-                    f"rollout chunk of {int(tokens.shape[0])} rows does not divide "
-                    f"evenly over {n_proc} processes — pick a chunk_size that is a "
-                    "positive multiple of the process count"
-                )
-            chunk_rows = int(tokens.shape[0]) // n_proc
-            need_more = n_collected + chunk_rows < num_rollouts
-            t = time.time()
-            if need_more:
-                pending = self._generate_next_chunk()
+        # Final-chunk stats for logging; placeholders are never logged (the
+        # aborted path returns before the tracker call).
+        last_scores = np.zeros((1,), dtype=np.float32)
+        last_kl = np.zeros((1, 1), dtype=np.float32)
 
-            # ONE device→host pull of the generation grids per chunk — both
-            # reward paths and the store push reuse these host rows.
-            tokens_h, mask_h = self.rl_model.to_local_host((tokens, mask))
-            gen_s += time.time() - t
-            ds = self.rl_model.rollout_decode_stats(mask_h, P)
-            gen_tokens += ds["gen_tokens"]
-            decode_steps.append(ds["decode_steps"])
-            step_budget = ds["decode_step_budget"]
-
-            if getattr(self.rl_model, "has_reward_model", False):
-                # On-device learned RM: the whole scoring pass (policy
-                # logprobs/values, hydra ref KL, RM scores) is ONE fused
-                # sharded program — no decode, no host reward boundary.
-                t = time.time()
-                logprobs, values, rewards, kl, scores = self.rl_model.rollout_score_rm(
-                    tokens, mask
-                )
-                scores = self.rl_model.to_local_host(scores)
-                score_s += time.time() - t
-            else:
-                # Host boundary: decode → user reward_fn. Process-LOCAL on
-                # every host: these are this process's rows only, reward_fn
-                # scores them, and rollout_score's put_batch reassembles the
-                # global scores array — so a multi-host pod never
-                # materializes non-addressable shards on any single host
-                # (the reference's per-rank reward_fn semantics,
-                # reference: trlx/orchestrator/ppo_orchestrator.py:73).
-                # Overlaps the pending generation running on device.
-                t = time.time()
-                texts_or_tokens = self.rl_model.decode(tokens_h, mask_h)
-                scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
-                reward_s += time.time() - t
-
-                # Device: score rollouts. Fused: ref-branch replay only, the
-                # policy stats rode along with generation. Unfused: full
-                # policy forward + ref logits + KL rewards in one program.
-                t = time.time()
-                if gen_aux is not None:
-                    logprobs, values, rewards, kl = self.rl_model.rollout_score_fused(
-                        tokens, mask, scores, gen_aux
-                    )
-                else:
-                    logprobs, values, rewards, kl = self.rl_model.rollout_score(tokens, mask, scores)
-                score_s += time.time() - t
-
+        def push_rows(tokens_h, mask_h, P, logprobs, values, rewards):
             # Store holds process-local rows; put_batch re-shards them on the
             # way back to the device at train time.
-            t = time.time()
-            logprobs, values, rewards, kl = self.rl_model.to_local_host(
-                (logprobs, values, rewards, kl)
-            )
-            score_s += time.time() - t
-            t = time.time()
+            nonlocal push_s
+            t0 = time.time()
             # With prompt bucketing the chunks arrive at per-bucket widths P,
             # but the rollout store fixes its query width on the FIRST push
             # and the train step compiles at the single full prompt_length —
@@ -208,47 +173,205 @@ class PPOOrchestrator(Orchestrator):
             # width here, on the host, before storage. Pad rows are mask-0:
             # the training forward sees exactly the tokens generation saw.
             q_ids, q_mask = tokens_h[:, :P], mask_h[:, :P]
-            P_full = int(getattr(self.rl_model, "prompt_length", P))
+            P_full = int(getattr(rl, "prompt_length", P))
             if P < P_full:
-                pad_id = int(getattr(self.rl_model, "pad_token_id", 0))
+                pad_id = int(getattr(rl, "pad_token_id", 0))
                 pad = np.full((q_ids.shape[0], P_full - P), pad_id, dtype=np.asarray(q_ids).dtype)
                 q_ids = np.concatenate([pad, q_ids], axis=1)
                 q_mask = np.concatenate([np.zeros_like(pad), np.asarray(q_mask)], axis=1)
-            self.rl_model.store.push_batch(
-                {
-                    "query_tensors": q_ids,
-                    "query_mask": q_mask,
-                    "response_tensors": tokens_h[:, P:],
-                    "response_mask": mask_h[:, P:],
-                    "logprobs": logprobs,
-                    "values": values,
-                    "rewards": rewards,
-                }
-            )
-            push_s += time.time() - t
-            n_collected += chunk_rows
-            if not need_more:
-                break
+            rows = {
+                "query_tensors": q_ids,
+                "query_mask": q_mask,
+                "response_tensors": tokens_h[:, P:],
+                "response_mask": mask_h[:, P:],
+                "logprobs": logprobs,
+                "values": values,
+                "rewards": rewards,
+            }
+            if record_staleness:
+                rows["staleness"] = np.full((q_ids.shape[0], 1), float(staleness), dtype=np.float32)
+            store.push_batch(rows)
+            push_s += time.time() - t0
+
+        def finish_chunk(ctx, scores):
+            # Device scoring + pulls + store push for one scored chunk. Runs
+            # on the make_experience thread ONLY — all device dispatch stays
+            # on one thread, so program order is deterministic.
+            nonlocal score_s, last_scores, last_kl
+            t0 = time.time()
+            if ctx["gen_aux"] is not None:
+                logprobs, values, rewards, kl = rl.rollout_score_fused(
+                    ctx["tokens"], ctx["mask"], scores, ctx["gen_aux"], snapshot=snapshot
+                )
+            else:
+                logprobs, values, rewards, kl = rl.rollout_score(
+                    ctx["tokens"], ctx["mask"], scores, snapshot=snapshot
+                )
+            logprobs, values, rewards, kl = rl.to_local_host((logprobs, values, rewards, kl))
+            score_s += time.time() - t0
+            push_rows(ctx["tokens_h"], ctx["mask_h"], ctx["P"], logprobs, values, rewards)
+            last_scores, last_kl = np.asarray(scores), kl
+
+        def host_score(args):
+            # Host boundary: decode → user reward_fn. Process-LOCAL on every
+            # host: these are this process's rows only, reward_fn scores
+            # them, and rollout_score's put_batch reassembles the global
+            # scores array — so a multi-host pod never materializes
+            # non-addressable shards on any single host (the reference's
+            # per-rank reward_fn semantics, reference:
+            # trlx/orchestrator/ppo_orchestrator.py:73). Runs on the
+            # ScoreWorker thread when overlap is on (self.score's retry/
+            # timeout wrapper nests fine there — its watchdog is its own
+            # daemon thread), inline otherwise.
+            tokens_h, mask_h = args
+            texts_or_tokens = rl.decode(tokens_h, mask_h)
+            return np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+
+        worker = None
+        inflight = None
+        depth = 0
+        if use_worker:
+            depth = max(1, int(getattr(rl.config.method, "score_queue_depth", 2) or 2))
+            worker = ScoreWorker(host_score, depth=depth)
+            inflight = deque()
+
+        t = time.time()
+        pending = self._generate_next_chunk(snapshot=snapshot)
+        gen_s += time.time() - t
+        heartbeat = getattr(rl, "heartbeat", None)
+        aborted = False
+        try:
+            while True:
+                if stop is not None and stop():
+                    # Producer shutdown mid-phase: abandon the partial store
+                    # (the producer drops it) without waiting out the queue.
+                    aborted = True
+                    return
+                if heartbeat is not None:
+                    # Rollout progress stamp: without it, a long experience
+                    # phase looks identical to a wedged host in the stall
+                    # report — the phase tag tells the CollectiveTimeout
+                    # diagnostic this host was generating, not stuck.
+                    heartbeat.beat(step=iter_count, phase="rollout")
+                tokens, mask, P, gen_aux = pending
+                # Rows THIS process will store (num_rollouts is per-process,
+                # the reference's per-rank semantics). Static shape — no
+                # device sync.
+                n_proc = jax.process_count()
+                if int(tokens.shape[0]) % n_proc != 0 or int(tokens.shape[0]) < n_proc:
+                    raise ValueError(
+                        f"rollout chunk of {int(tokens.shape[0])} rows does not divide "
+                        f"evenly over {n_proc} processes — pick a chunk_size that is a "
+                        "positive multiple of the process count"
+                    )
+                chunk_rows = int(tokens.shape[0]) // n_proc
+                need_more = n_collected + chunk_rows < num_rollouts
+                t = time.time()
+                if need_more:
+                    pending = self._generate_next_chunk(snapshot=snapshot)
+
+                # ONE device→host pull of the generation grids per chunk —
+                # both reward paths and the store push reuse these host rows.
+                tokens_h, mask_h = rl.to_local_host((tokens, mask))
+                gen_s += time.time() - t
+                ds = rl.rollout_decode_stats(mask_h, P)
+                gen_tokens += ds["gen_tokens"]
+                decode_steps.append(ds["decode_steps"])
+                step_budget = ds["decode_step_budget"]
+
+                if getattr(rl, "has_reward_model", False):
+                    # On-device learned RM: the whole scoring pass (policy
+                    # logprobs/values, hydra ref KL, RM scores) is ONE fused
+                    # sharded program — no decode, no host reward boundary
+                    # (and so nothing for a score worker to overlap).
+                    t = time.time()
+                    logprobs, values, rewards, kl, scores = rl.rollout_score_rm(
+                        tokens, mask, snapshot=snapshot
+                    )
+                    scores = rl.to_local_host(scores)
+                    logprobs, values, rewards, kl = rl.to_local_host(
+                        (logprobs, values, rewards, kl)
+                    )
+                    score_s += time.time() - t
+                    push_rows(tokens_h, mask_h, P, logprobs, values, rewards)
+                    last_scores, last_kl = np.asarray(scores), kl
+                elif worker is not None:
+                    # Hand decode+reward to the worker; keep the device busy.
+                    # Drain completed scores eagerly (FIFO pairs results with
+                    # the inflight contexts) and block only when the queue of
+                    # decoded-but-unscored chunks hits its depth bound.
+                    worker.submit((tokens_h, mask_h))
+                    inflight.append(
+                        {
+                            "tokens": tokens,
+                            "mask": mask,
+                            "P": P,
+                            "gen_aux": gen_aux,
+                            "tokens_h": tokens_h,
+                            "mask_h": mask_h,
+                        }
+                    )
+                    while inflight and (len(inflight) > depth or worker.ready()):
+                        finish_chunk(inflight.popleft(), worker.result())
+                else:
+                    t = time.time()
+                    scores = host_score((tokens_h, mask_h))
+                    reward_s += time.time() - t
+                    # Device: score rollouts. Fused: ref-branch replay only,
+                    # the policy stats rode along with generation. Unfused:
+                    # full policy forward + ref logits + KL in one program.
+                    finish_chunk(
+                        {
+                            "tokens": tokens,
+                            "mask": mask,
+                            "P": P,
+                            "gen_aux": gen_aux,
+                            "tokens_h": tokens_h,
+                            "mask_h": mask_h,
+                        },
+                        scores,
+                    )
+                n_collected += chunk_rows
+                if not need_more:
+                    break
+            if worker is not None:
+                while inflight:
+                    if stop is not None and stop():
+                        aborted = True
+                        return
+                    finish_chunk(inflight.popleft(), worker.result())
+        finally:
+            if worker is not None:
+                worker.close()
+                # Host decode+reward wall, measured on the worker. Joined, so
+                # the read is race-free.
+                reward_s += worker.busy_s
+            if timer is not None and not aborted:
+                timer.add("rollout", gen_s + score_s + push_s)
+                timer.add("score", reward_s)
 
         exp_time = clock.tick()
         # Process-local statistics of the final chunk (logging only).
-        self.rl_model.tracker.log(
-            {
-                "exp_time": exp_time,
-                "exp_gen_s": gen_s,
-                "exp_reward_s": reward_s,
-                "exp_score_s": score_s,
-                "exp_push_s": push_s,
-                # Decode-loop observability: generated tokens per second of
-                # generate-BLOCKED wall time (pipelining hides device time
-                # behind host work, so this is a lower bound on the device
-                # rate), and the per-chunk while_loop steps actually executed
-                # vs the max_new_tokens budget (early-exit savings).
-                "exp_decode_tokens_per_s": gen_tokens / max(gen_s, 1e-9),
-                "exp_decode_steps": float(np.mean(decode_steps)),
-                "exp_decode_step_budget": float(step_budget),
-                "rollout_mean_score": float(np.mean(scores)),
-                "rollout_mean_kl": float(np.mean(kl.sum(-1))),
-            },
-            step=iter_count,
-        )
+        stats = {
+            "exp_time": exp_time,
+            "exp_gen_s": gen_s,
+            "exp_reward_s": reward_s,
+            "exp_score_s": score_s,
+            "exp_push_s": push_s,
+            # Decode-loop observability: generated tokens per second of
+            # generate-BLOCKED wall time (pipelining hides device time
+            # behind host work, so this is a lower bound on the device
+            # rate), and the per-chunk while_loop steps actually executed
+            # vs the max_new_tokens budget (early-exit savings).
+            "exp_decode_tokens_per_s": gen_tokens / max(gen_s, 1e-9),
+            "exp_decode_steps": float(np.mean(decode_steps)),
+            "exp_decode_step_budget": float(step_budget),
+            "rollout_mean_score": float(np.mean(last_scores)),
+            "rollout_mean_kl": float(np.mean(np.asarray(last_kl).sum(-1))),
+            "exp_per_sec": num_rollouts / max(exp_time, 1e-9),
+        }
+        if record_staleness:
+            stats["exp_staleness"] = float(staleness)
+        # Surfaced by progress_line at the next log boundary.
+        rl._last_exp_stats = {"exp_per_sec": stats["exp_per_sec"]}
+        rl.tracker.log(stats, step=iter_count)

@@ -29,11 +29,19 @@ _FIELD_SPECS = (
     ("rewards", "R", np.float32),
 )
 
+# Per-row staleness (training iterations between the policy that generated a
+# sample and the policy trained on it — 0 when fully on-policy). A SEPARATE
+# spec gated on record_staleness: serial stores keep the exact 7-column
+# layout, only pipelined stores (method.rollout_overlap / max_staleness)
+# carry the extra column.
+_STALENESS_SPEC = ("staleness", 1, np.float32)
+
 
 class PPORolloutStorage(BaseRolloutStore):
-    def __init__(self, pad_token_id: int = 0):
+    def __init__(self, pad_token_id: int = 0, record_staleness: bool = False):
         super().__init__()
         self.pad_token_id = pad_token_id
+        self.record_staleness = bool(record_staleness)
         self._buffer = None  # created lazily at first push (widths from data)
 
     def _ensure_buffer(self, P: int, R: int):
@@ -41,17 +49,22 @@ class PPORolloutStorage(BaseRolloutStore):
             from trlx_tpu.native import RolloutBuffer
 
             widths = {"P": P, "R": R}
-            self._buffer = RolloutBuffer(
-                [(name, widths[w], dt) for name, w, dt in _FIELD_SPECS]
-            )
+            specs = [(name, widths[w], dt) for name, w, dt in _FIELD_SPECS]
+            if self.record_staleness:
+                specs.append(_STALENESS_SPEC)
+            self._buffer = RolloutBuffer(specs)
         return self._buffer
 
     def push_batch(self, arrays: Dict[str, np.ndarray]) -> int:
         """Append a chunk of rollout rows (the orchestrator's fast path)."""
+        q = np.asarray(arrays["query_tensors"])
         buf = self._ensure_buffer(
-            np.asarray(arrays["query_tensors"]).shape[1],
+            q.shape[1],
             np.asarray(arrays["response_tensors"]).shape[1],
         )
+        if self.record_staleness and "staleness" not in arrays:
+            arrays = dict(arrays)
+            arrays["staleness"] = np.zeros((q.shape[0], 1), dtype=np.float32)
         return buf.push(arrays)
 
     def push(self, exps: Iterable[PPORLElement]):
@@ -92,9 +105,15 @@ class PPORolloutStorage(BaseRolloutStore):
 
     def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> BatchLoader:
         buffer = self._buffer
+        record_staleness = self.record_staleness
 
         def collate(ixs):
             g = buffer.gather(np.asarray(ixs))
+            extras = None
+            if record_staleness:
+                # Host-side batch metadata: the trainer strips it before
+                # put_batch, logs staleness/mean|max at log boundaries.
+                extras = {"staleness": g["staleness"][:, 0]}
             return PPORLBatch(
                 query_tensors=g["query_tensors"],
                 response_tensors=g["response_tensors"],
@@ -103,6 +122,7 @@ class PPORolloutStorage(BaseRolloutStore):
                 rewards=g["rewards"],
                 response_mask=g["response_mask"],
                 query_mask=g["query_mask"],
+                extras=extras,
             )
 
         return BatchLoader(len(self), batch_size, collate, shuffle=shuffle, drop_last=True, seed=seed)

@@ -15,6 +15,7 @@ reference delegates to Accelerate/DeepSpeed is explicit here:
 import os
 import signal
 import sys
+import threading
 import time
 import warnings
 from abc import abstractmethod
@@ -44,6 +45,7 @@ from trlx_tpu.resilience import (
     FaultPlan,
     TrainingDiverged,
 )
+from trlx_tpu.pipeline.overlap import PrefetchIterator, SerialFeed
 from trlx_tpu.resilience import checkpoint as ckpt_util
 from trlx_tpu.resilience import distributed as dist_res
 from trlx_tpu.resilience.faults import poison_nan
@@ -149,6 +151,23 @@ class JaxBaseTrainer(BaseRLTrainer):
             self._validate_data_sharding(chunk, "method.chunk_size (rollout chunk)")
 
         self.rng = jax.random.PRNGKey(config.train.seed)
+        # next_rng is consumed from the main thread (eval) AND, with the
+        # pipelined rollout producer on, from the producer thread — the
+        # split-and-advance must be atomic.
+        self._rng_lock = threading.Lock()
+        # put_batch sharding cache: specs depend only on array rank (batch
+        # dim over DATA_AXES, rest replicated) and the mesh is fixed for the
+        # trainer's lifetime.
+        self._sharding_cache = {}
+        # Device-dispatch serialization for the staleness>0 rollout producer:
+        # two threads launching COLLECTIVE-bearing programs concurrently can
+        # enqueue them in different orders on different local devices, and
+        # XLA's rendezvous then deadlocks (observed on the 8-device CPU mesh:
+        # half the devices enter run A's all-reduce, half run B's). Holding
+        # this lock across the dispatch call (not the execution — dispatch is
+        # async) keeps every device queue in one global program order.
+        # Uncontended acquire is ~100ns; the serial path never contends.
+        self._dispatch_lock = threading.RLock()
         self.tokenizer = self._build_tokenizer(config.model.tokenizer_path)
 
         # Subclass builds the Flax module + initial host params.
@@ -378,22 +397,36 @@ class JaxBaseTrainer(BaseRLTrainer):
         }
 
     def next_rng(self):
-        self.rng, sub = jax.random.split(self.rng)
-        return sub
+        with getattr(self, "_rng_lock", None) or threading.Lock():
+            self.rng, sub = jax.random.split(self.rng)
+            return sub
 
     def put_batch(self, tree):
         """Host batch → device, batch dim sharded over (dp, fsdp).
 
         Multi-host: each process feeds its local shard
         (the WORLD_SIZE batch-scaling semantics of the reference,
-        reference: trlx/trlx.py:47, live here)."""
+        reference: trlx/trlx.py:47, live here).
+
+        Shardings are cached per array rank: the spec is fully determined by
+        ndim (batch dim over DATA_AXES, every other dim replicated) and the
+        mesh is fixed, so rebuilding a NamedSharding per leaf per step was
+        pure allocation overhead on the hot path."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cache = getattr(self, "_sharding_cache", None)
+        if cache is None:
+            cache = self._sharding_cache = {}
+        multihost = jax.process_count() > 1
 
         def put(x):
             x = np.asarray(x)
-            spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
-            sharding = NamedSharding(self.mesh, spec)
-            if jax.process_count() > 1:
+            entry = cache.get(x.ndim)
+            if entry is None:
+                spec = P(DATA_AXES, *([None] * (x.ndim - 1)))
+                entry = cache[x.ndim] = (spec, NamedSharding(self.mesh, spec))
+            spec, sharding = entry
+            if multihost:
                 from jax.experimental import multihost_utils
 
                 return multihost_utils.host_local_array_to_global_array(x, self.mesh, spec)
@@ -442,6 +475,10 @@ class JaxBaseTrainer(BaseRLTrainer):
         line per log step when stderr is a file."""
         if not is_main_process() or os.environ.get("TRLX_TPU_NO_PROGRESS"):
             return
+        # Fold in the last rollout-phase window (exp/s, time/* split) so the
+        # line shows the full iteration economics, not just the train step.
+        merged = dict(getattr(self, "_last_phase_stats", None) or {})
+        merged.update(stats_host)
         parts = [f"step {self.iter_count}/{self.total_steps}"]
         for key, label in (
             ("loss", "loss"),
@@ -449,9 +486,19 @@ class JaxBaseTrainer(BaseRLTrainer):
             ("mean_kl", "kl"),
             ("metrics/optimality", "optimality"),
             ("samples_per_sec", "samples/s"),
+            ("exp_per_sec", "exp/s"),
         ):
-            if key in stats_host:
-                parts.append(f"{label}={stats_host[key]:.4g}")
+            if key in merged:
+                parts.append(f"{label}={merged[key]:.4g}")
+        if all(f"time/{p}_s" in merged for p in ("rollout", "score", "train")):
+            parts.append(
+                "phases r/s/t={:.1f}/{:.1f}/{:.1f}s ov={:.0%}".format(
+                    merged["time/rollout_s"],
+                    merged["time/score_s"],
+                    merged["time/train_s"],
+                    merged.get("time/overlap_fraction", 0.0),
+                )
+            )
         # \x1b[K clears to end-of-line so a previous longer line (e.g. one
         # with eval-only keys) leaves no remnants after the rewrite.
         print("  ".join(parts) + "\x1b[K", end="\r", file=sys.stderr, flush=True)
@@ -665,6 +712,12 @@ class JaxBaseTrainer(BaseRLTrainer):
         try:
             return self._learn_loop(profiler_tick)
         finally:
+            # Pipeline machinery first: a live prefetch thread or rollout
+            # producer must be stopped/joined before the checkpoint drain —
+            # an early return (preemption, total_steps mid-epoch) leaves
+            # them running otherwise.
+            self._close_batch_feed()
+            self._shutdown_experience_pipeline()
             self.end_progress()
             # An async interval save may still be in flight — its sidecars
             # (manifest, latest.txt) only land at finalize, so the exit path
@@ -695,9 +748,66 @@ class JaxBaseTrainer(BaseRLTrainer):
             np.any(allgather_host(np.asarray([self._preempted], dtype=np.int32)))
         )
 
+    # ------------------------------------------------------ pipelined batches
+
+    def _prepare_batch(self, batch):
+        """Host batch → (device_batch, host_extras). Host-only extras (the
+        per-sample staleness column from the pipelined producer) are split
+        off BEFORE put_batch so they never ride to device or change the
+        jitted step's input pytree."""
+        host_extras = None
+        if getattr(batch, "extras", None) is not None:
+            from dataclasses import replace
+
+            host_extras = batch.extras
+            batch = replace(batch, extras=None)
+        return self.put_batch(batch), host_extras
+
+    def _train_batch_feed(self):
+        """One epoch's batch feed, yielding (device_batch, host_extras).
+
+        Serial by default (put_batch inline, today's exact schedule). When
+        the subclass enables the pipeline (PPO's overlap knobs), batches are
+        staged through a PrefetchIterator so the host→device transfer for
+        batch k+1 overlaps train_step(k). Multi-host note: put_batch's
+        host_local_array_to_global_array is collective-free, so running it
+        on the prefetch thread cannot interleave with main-thread
+        collectives."""
+        depth = 0
+        if getattr(self, "overlap_rollouts", False):
+            depth = max(0, int(getattr(self.config.method, "prefetch_depth", 0) or 0))
+        if depth > 0:
+            feed = PrefetchIterator(self.train_dataloader, self._prepare_batch, depth=depth)
+        else:
+            feed = SerialFeed(self.train_dataloader, self._prepare_batch)
+        self._active_feed = feed
+        return feed
+
+    def _close_batch_feed(self):
+        feed = getattr(self, "_active_feed", None)
+        if feed is not None:
+            self._active_feed = None
+            feed.close()
+
+    def _shutdown_experience_pipeline(self):
+        """Stop background experience machinery (rollout producer, score
+        worker) — no-op here; subclasses that arm them override."""
+
     def _learn_loop(self, profiler_tick):
+        timer = getattr(self, "_phase_timer", None)
         for epoch in range(self.config.train.epochs):
-            for batch in self.train_dataloader:
+            feed = self._train_batch_feed()
+            while True:
+                data_t0 = time.time()
+                try:
+                    # put_batch already ran (inline via SerialFeed, or ahead
+                    # of time on the prefetch thread) — this pop measures the
+                    # residual host→device blocking the train step pays.
+                    device_batch, host_extras = next(feed)
+                except StopIteration:
+                    break
+                self._data_s = getattr(self, "_data_s", 0.0) + (time.time() - data_t0)
+                self._last_batch_extras = host_extras
                 # SIGTERM may land during the (long) rollout phase that
                 # rebuilt this dataloader — checkpoint before spending a
                 # further step on a doomed VM. Checked once per BATCH (not
@@ -706,9 +816,8 @@ class JaxBaseTrainer(BaseRLTrainer):
                 if self._preemption_agreed():
                     self._save_on_preemption()
                     return None
-                data_t0 = time.time()
-                device_batch = self.put_batch(batch)
-                self._data_s = getattr(self, "_data_s", 0.0) + (time.time() - data_t0)
+                train_t0 = time.time()
+                self._phase_exclude_s = 0.0  # eval/save wall inside the window
                 for _ in range(self.n_updates_per_batch):
                     profiler_tick()
                     forward_t0 = time.time()
@@ -720,7 +829,8 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # leaves of THIS step's batch (fault drill for the
                         # on-device non-finite guard).
                         step_batch = poison_nan(device_batch)
-                    self.state, stats = self.train_step(self.state, step_batch)
+                    with self._dispatch_lock:
+                        self.state, stats = self.train_step(self.state, step_batch)
                     self.iter_count += 1
                     if self.heartbeat is not None:
                         # Progress stamp (cheap attribute stores; the
@@ -794,6 +904,19 @@ class JaxBaseTrainer(BaseRLTrainer):
                             stats_host["step_gap"] = time.time() - self._last_log_t
                         if intervals["do_eval"]:
                             stats_host.update(self.evaluate())
+                            # Eval wall must not count as train-phase time in
+                            # the overlap window (single-host reads it back;
+                            # non-main pod hosts return a reduced stats dict).
+                            self._phase_exclude_s += stats_host.get("eval_wall_time", 0.0)
+                        extras = getattr(self, "_last_batch_extras", None)
+                        if extras:
+                            # Host-side batch metadata (e.g. the staleness
+                            # column from the pipelined producer): log-boundary
+                            # stats only, never device traffic.
+                            for k, v in extras.items():
+                                v = np.asarray(v)
+                                stats_host[f"{k}/mean"] = float(v.mean())
+                                stats_host[f"{k}/max"] = float(v.max())
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
                         self._last_log_t = time.time()
@@ -835,6 +958,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                     if self.iter_count >= self.total_steps:
                         self.save()
                         return self.evaluate()
+                if timer is not None:
+                    timer.add(
+                        "train",
+                        max(0.0, time.time() - train_t0 - self._phase_exclude_s),
+                    )
+            self._close_batch_feed()
             self.post_epoch_callback()
 
         self.save()

@@ -22,6 +22,7 @@ from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.modeling import logprobs_from_logits
 from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
 from trlx_tpu.ops.sampling import GenerateConfig
+from trlx_tpu.pipeline.overlap import PhaseTimer, RolloutProducer
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.resilience.guard import guarded_update
 from trlx_tpu.trainer import register_model
@@ -62,7 +63,33 @@ class PPOTrainer(JaxBaseTrainer):
         super().__init__(config, **kwargs)
         m = config.method
 
-        self.store = PPORolloutStorage(self.pad_token_id)
+        # Pipelined rollout/train overlap (trlx_tpu/pipeline/overlap.py).
+        # overlap_rollouts turns the machinery on: background reward scoring,
+        # device batch prefetch, and the double-buffered rollout producer.
+        # max_staleness > 0 additionally lets the producer generate off a
+        # boundary param snapshot while training runs — bounded off-policy.
+        self.max_staleness = max(0, int(getattr(m, "max_staleness", 0) or 0))
+        self.overlap_rollouts = bool(getattr(m, "rollout_overlap", False)) or self.max_staleness > 0
+        if self.max_staleness > 0 and jax.process_count() > 1:
+            # Two threads dispatching device programs concurrently cannot
+            # guarantee the same collective launch order on every host — the
+            # classic multi-controller deadlock. Staleness-0 overlap is safe
+            # (the producer only runs while the main thread is parked in
+            # next_store, and its device work is collective-free).
+            raise ValueError(
+                "method.max_staleness > 0 is single-host only: concurrent "
+                "rollout generation and training would interleave device "
+                "program dispatch differently across hosts. Use "
+                "method.rollout_overlap (staleness 0) on multi-host pods."
+            )
+        self._phase_timer = PhaseTimer()
+        self._rollout_producer = None
+        self._last_exp_stats = None
+
+        # record_staleness is decided ONCE here so iteration 0's store (the
+        # pre-learn fill) and every producer-built store share one column
+        # layout — and therefore one batch pytree and one train-step trace.
+        self.store = PPORolloutStorage(self.pad_token_id, record_staleness=self.overlap_rollouts)
 
         if m.target is not None:
             self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
@@ -280,22 +307,27 @@ class PPOTrainer(JaxBaseTrainer):
         )
         return lp, values, rewards, kl, scores
 
-    def rollout_score_rm(self, tokens, mask):
+    def rollout_score_rm(self, tokens, mask, snapshot=None):
         """Fused rollout scoring with the ON-DEVICE reward model: policy
         logprobs + values + hydra ref KL + RM scores in one program — no
-        decode, no host boundary."""
-        return self._score_rm_fn_for(self._batch_prompt_length(tokens))(
-            self.state.params,
-            self.state.extras,
-            self.rm_params,
-            tokens,
-            mask,
-            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
-        )
+        decode, no host boundary. rm_params stay live in every mode: the RM
+        is not part of the TrainState, so it is never donated."""
+        params = self.state.params if snapshot is None else snapshot["params"]
+        extras = self.state.extras if snapshot is None else snapshot["extras"]
+        with self._dispatch_lock:
+            return self._score_rm_fn_for(self._batch_prompt_length(tokens))(
+                params,
+                extras,
+                self.rm_params,
+                tokens,
+                mask,
+                jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+            )
 
     def rm_eval_scores(self, tokens, mask):
         """RM scores for eval generations (device arrays in/out)."""
-        return self._rm_eval_fn(self.rm_params, tokens, mask)
+        with self._dispatch_lock:
+            return self._rm_eval_fn(self.rm_params, tokens, mask)
 
     def make_extras(self, init_params):
         """The frozen ref branch = initial top-k blocks + head
@@ -309,9 +341,36 @@ class PPOTrainer(JaxBaseTrainer):
 
     # --------------------------------------------------------------- rollout
 
-    def _decode_variables(self):
-        """Variable collections for the decode programs: live params, plus
-        the int8 weight copies when W8A16 decode is on."""
+    def _rollout_snapshot(self):
+        """Deep device copy of everything rollouts read from the TrainState:
+        policy params, the frozen ref branch (extras), and re-quantized int8
+        decode weights. Needed at max_staleness > 0 ONLY — the jitted train
+        step donates the whole TrainState, so a producer thread reading the
+        live state mid-train would touch deleted buffers. Taken on the MAIN
+        thread at iteration boundaries (prepare_learning / post_epoch), when
+        no train step is in flight."""
+        with self._dispatch_lock:
+            snap = {
+                "params": jax.tree_util.tree_map(jnp.copy, self.state.params),
+                "extras": (
+                    None
+                    if self.state.extras is None
+                    else jax.tree_util.tree_map(jnp.copy, self.state.extras)
+                ),
+            }
+            if self._qw is not None:
+                snap["qw"] = self._quantize_fn(snap["params"])
+            return snap
+
+    def _decode_variables(self, snapshot=None):
+        """Variable collections for the decode programs: live params (plus
+        the int8 weight copies when W8A16 decode is on), or the producer's
+        boundary snapshot of both."""
+        if snapshot is not None:
+            v = {"params": snapshot["params"]}
+            if snapshot.get("qw") is not None:
+                v["qw"] = snapshot["qw"]
+            return v
         v = {"params": self.state.params}
         if self._qw is not None:
             v["qw"] = self._qw
@@ -321,7 +380,8 @@ class PPOTrainer(JaxBaseTrainer):
         """Re-quantize the int8 decode kernels from the LIVE policy — called
         before every rollout phase so the sampler never lags the optimizer."""
         if self._qw is not None:
-            self._qw = self._quantize_fn(self.state.params)
+            with self._dispatch_lock:
+                self._qw = self._quantize_fn(self.state.params)
 
     def _batch_prompt_length(self, tokens) -> int:
         """The prompt width of a rollout batch: total width minus the (fixed)
@@ -350,19 +410,26 @@ class PPOTrainer(JaxBaseTrainer):
             self._score_rm_fns[P] = fn
         return fn
 
-    def rollout_generate(self, input_ids, attention_mask):
+    def rollout_generate(self, input_ids, attention_mask, snapshot=None):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
-        return self._generate_fn(self._decode_variables(), batch["i"], batch["m"], self.next_rng())
+        # _dispatch_lock: generation runs on the producer thread at
+        # max_staleness > 0 while the main thread dispatches train steps —
+        # see JaxBaseTrainer.__init__ for the rendezvous hazard.
+        with self._dispatch_lock:
+            return self._generate_fn(
+                self._decode_variables(snapshot), batch["i"], batch["m"], self.next_rng()
+            )
 
-    def rollout_generate_fused(self, input_ids, attention_mask):
+    def rollout_generate_fused(self, input_ids, attention_mask, snapshot=None):
         """Generation that also emits the rollout statistics (sampled-token
         logprobs, values, branch hiddens) collected inside the decode loop.
         Returns (tokens, mask, stats, prefill_extras) — feed the last two to
         rollout_score_fused."""
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
-        return self._generate_fused_fn(
-            self._decode_variables(), batch["i"], batch["m"], self.next_rng()
-        )
+        with self._dispatch_lock:
+            return self._generate_fused_fn(
+                self._decode_variables(snapshot), batch["i"], batch["m"], self.next_rng()
+            )
 
     def _rollout_score_fused_impl(self, extras, tokens, mask, scores, kl_coef, logprob, value, bh_steps, bh_prefill, *, prompt_length: int):
         """Scoring with decode-collected stats: ONLY the frozen ref branch
@@ -388,20 +455,22 @@ class PPOTrainer(JaxBaseTrainer):
         rewards, kl = kl_penalty_rewards(logprob, rlp, rmask, scores, kl_coef)
         return logprob, value, rewards, kl
 
-    def rollout_score_fused(self, tokens, mask, scores, gen_aux):
+    def rollout_score_fused(self, tokens, mask, scores, gen_aux, snapshot=None):
         stats, prefill_extras = gen_aux
+        extras = self.state.extras if snapshot is None else snapshot["extras"]
         scores = self.put_batch(np.asarray(scores, dtype=np.float32))
-        return self._score_fused_fn_for(self._batch_prompt_length(tokens))(
-            self.state.extras,
-            tokens,
-            mask,
-            scores,
-            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
-            stats["logprob"],
-            stats["value"],
-            stats["branch_hidden"],
-            prefill_extras["branch_hidden"],
-        )
+        with self._dispatch_lock:
+            return self._score_fused_fn_for(self._batch_prompt_length(tokens))(
+                extras,
+                tokens,
+                mask,
+                scores,
+                jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+                stats["logprob"],
+                stats["value"],
+                stats["branch_hidden"],
+                prefill_extras["branch_hidden"],
+            )
 
     def _rollout_score_impl(self, params, extras, tokens, mask, scores, kl_coef, *, prompt_length: int):
         P = prompt_length
@@ -430,16 +499,19 @@ class PPOTrainer(JaxBaseTrainer):
         rewards, kl = kl_penalty_rewards(lp, rlp, rmask, scores, kl_coef)
         return lp, values, rewards, kl
 
-    def rollout_score(self, tokens, mask, scores):
+    def rollout_score(self, tokens, mask, scores, snapshot=None):
+        params = self.state.params if snapshot is None else snapshot["params"]
+        extras = self.state.extras if snapshot is None else snapshot["extras"]
         scores = self.put_batch(np.asarray(scores, dtype=np.float32))
-        return self._score_fn_for(self._batch_prompt_length(tokens))(
-            self.state.params,
-            self.state.extras,
-            tokens,
-            mask,
-            scores,
-            jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
-        )
+        with self._dispatch_lock:
+            return self._score_fn_for(self._batch_prompt_length(tokens))(
+                params,
+                extras,
+                tokens,
+                mask,
+                scores,
+                jnp.asarray(self.kl_ctl.value, dtype=jnp.float32),
+            )
 
     # ------------------------------------------------------------ train step
 
@@ -513,9 +585,34 @@ class PPOTrainer(JaxBaseTrainer):
         (reference: trlx/model/accelerate_ppo_model.py:157-161)."""
         self._flush_kl_updates()  # rollout rewards consume kl_ctl.value
         self._refresh_decode_weights()  # sampler follows the updated policy
-        self.store.clear_history()
-        self.orch.make_experience(self.config.method.num_rollouts, self.iter_count)
+        if self._rollout_producer is None:
+            # Serial schedule: generate the next iteration's experience
+            # inline, into the (cleared) long-lived store.
+            self.store.clear_history()
+            self.orch.make_experience(self.config.method.num_rollouts, self.iter_count)
+        else:
+            # Pipelined schedule: release the producer (one iteration fully
+            # consumed, decode weights refreshed above — the staleness-0
+            # producer reads the LIVE state while this thread blocks in
+            # next_store) and swap in its double buffer. At staleness > 0 the
+            # boundary snapshot travels with the release so the producer
+            # never touches donated buffers.
+            snapshot = self._rollout_snapshot() if self.max_staleness > 0 else None
+            self._rollout_producer.consume_done(snapshot=snapshot)
+            self.store = self._rollout_producer.next_store()
         self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        self._log_phase_window()
+
+    def _log_phase_window(self):
+        """Flush the phase timer at the rollout boundary: one window spans
+        train(iter n) + rollout/score(iter n+1) — the span the pipeline
+        overlaps — and feeds time/* + overlap_fraction to the tracker and
+        the progress line."""
+        stats = self._phase_timer.window()
+        if self._last_exp_stats:
+            stats.update(self._last_exp_stats)
+        self._last_phase_stats = stats
+        self.tracker.log(stats, step=self.iter_count)
 
     def prepare_learning(self):
         """(reference: trlx/model/accelerate_ppo_model.py:167-184)"""
@@ -526,6 +623,40 @@ class PPOTrainer(JaxBaseTrainer):
             self.config.train.epochs * self.n_updates_per_batch * len(self.train_dataloader),
             self.config.train.total_steps,
         )
+        orch = getattr(self, "orch", None)
+        if self.overlap_rollouts and orch is not None and self._rollout_producer is None:
+            num_rollouts = self.config.method.num_rollouts
+
+            def produce(store, index, snapshot, staleness, stop):
+                orch.make_experience(
+                    num_rollouts,
+                    self.iter_count,
+                    store=store,
+                    snapshot=snapshot,
+                    staleness=staleness,
+                    stop=stop,
+                )
+
+            def new_store():
+                return PPORolloutStorage(self.pad_token_id, record_staleness=True)
+
+            # At staleness 0 the producer starts parked (its first store is
+            # gated on the first consume_done) and needs no snapshot — it
+            # reads live state only while the main thread waits. At
+            # staleness >= 1 it starts generating iteration 1's experience
+            # immediately, off the same pre-training params that built
+            # iteration 0's store.
+            self._rollout_producer = RolloutProducer(
+                produce, new_store, max_staleness=self.max_staleness
+            ).start(snapshot=self._rollout_snapshot() if self.max_staleness > 0 else None)
+
+    def _shutdown_experience_pipeline(self):
+        """learn()'s finally: stop the producer before the run tears down
+        (also on the preemption/early-return paths)."""
+        producer = self._rollout_producer
+        if producer is not None:
+            self._rollout_producer = None
+            producer.shutdown()
 
 
 def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detach_frozen):
