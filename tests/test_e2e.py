@@ -475,3 +475,76 @@ def test_kl_controller_trajectory_invariant_to_log_interval(task, tmp_path):
     v4 = run(4, tmp_path / "b")
     assert v1 != pytest.approx(0.05), "controller never moved — test is vacuous"
     assert v4 == pytest.approx(v1, rel=1e-6)
+
+
+def test_ppo_e2e_packed_train_batch(task, tmp_path):
+    """method.pack_train_batch=True: episodes pack into dense bucketed rows
+    (block-diagonal attention, segment-gated GAE) and the whole train loop
+    completes, logging the packed-throughput metrics."""
+    import json
+
+    walks, logit_mask, metric_fn, reward_fn = task
+    config = shrink(base_config("ppo", 15, 8))
+    config.train.checkpoint_dir = str(tmp_path)
+    config.method.pack_train_batch = True
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[i] for i in range(1, 15)],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.iter_count >= 6
+    assert len(model.store) > 0
+    # packed rows shard over the data axes like any train batch
+    from trlx_tpu.data import PackedPPOBatch
+
+    batch = next(iter(model.train_dataloader))
+    assert isinstance(batch, PackedPPOBatch)
+    assert batch.input_ids.shape[0] % model._pack_rows_multiple == 0
+    # satellite metrics: tokens/s + fill fraction land in metrics.jsonl
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    assert any("train_tokens_per_s" in r for r in recs)
+    fills = [r["train_batch_fill"] for r in recs if "train_batch_fill" in r]
+    assert fills and all(0 < v <= 1 for v in fills)
+
+
+def test_ppo_packed_losses_match_unpacked(task, tmp_path):
+    """Same seed, same rollouts: the packed train step must reproduce the
+    unpacked losses (layout is a pure re-indexing of the same loss sum —
+    only float reassociation differs). With packing OFF the loader still
+    yields the plain PPORLBatch, i.e. the default path is untouched."""
+    import json
+
+    walks, logit_mask, metric_fn, reward_fn = task
+
+    def run(packed, sub):
+        config = shrink(base_config("ppo", 15, 8))
+        config.train.checkpoint_dir = str(tmp_path / sub)
+        config.train.total_steps = 2
+        config.method.pack_train_batch = packed
+        prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+        model = trlx_tpu.train(
+            reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+            metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+        )
+        with open(tmp_path / sub / "metrics.jsonl") as f:
+            recs = [json.loads(line) for line in f]
+        return model, {r["step"]: r for r in recs if "loss" in r}
+
+    model_u, logs_u = run(False, "unpacked")
+    model_p, logs_p = run(True, "packed")
+
+    from trlx_tpu.data import PackedPPOBatch, PPORLBatch
+
+    assert isinstance(next(iter(model_u.train_dataloader)), PPORLBatch)
+    assert isinstance(next(iter(model_p.train_dataloader)), PackedPPOBatch)
+
+    # step 1 trains on identical params + identical experience — packed vs
+    # unpacked is the same loss up to reassociation
+    assert 1 in logs_u and 1 in logs_p
+    assert logs_u[1]["loss"] == pytest.approx(logs_p[1]["loss"], rel=5e-3, abs=1e-5)
+    assert logs_u[1]["mean_kl"] == pytest.approx(logs_p[1]["mean_kl"], rel=5e-3, abs=1e-6)

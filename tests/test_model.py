@@ -175,3 +175,71 @@ def test_remat_grads_match():
     g1, _ = ravel_pytree(jax.grad(loss(plain))(params))
     g2, _ = ravel_pytree(jax.jit(jax.grad(loss(remat)))(params))
     np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Labels mode (the fused/streamed head API) + packed segments
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tied,bias", [(True, False), (False, False), (False, True)])
+def test_labels_mode_matches_logits_chain(tied, bias):
+    """labels= forward == logprobs_from_logits over the logits= forward,
+    bit-exact on CPU fp32 (the default route is the same math, only the head
+    application moves inside the model)."""
+    from trlx_tpu.ops.modeling import logprobs_from_logits
+
+    cfg = tiny_cfg(tie_word_embeddings=tied, extra={"lm_head_bias": bias})
+    model = LMWithValueHead(cfg, branch_layer=2)
+    rng = jax.random.PRNGKey(1)
+    ids = jax.random.randint(rng, (2, 10), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 10), dtype=jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+
+    P = 4
+    labels = ids[:, P:]
+    lmask = mask[:, P:]
+    out = model.apply(
+        {"params": params}, ids, mask, logits_start=P - 1,
+        labels=labels, labels_mask=lmask,
+    )
+    ref = model.apply({"params": params}, ids, mask, logits_start=P - 1)
+    want = logprobs_from_logits(ref["logits"][:, :-1].astype(jnp.float32), labels, lmask)
+    assert out["logits"] is None
+    np.testing.assert_array_equal(np.asarray(out["logprobs"]), np.asarray(want))
+    # init under labels mode must yield the IDENTICAL param tree (the head
+    # module shares scope/shapes with the logits-mode head)
+    p2 = model.init(rng, ids, mask, logits_start=P - 1, labels=labels, labels_mask=lmask)["params"]
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(p2)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p2)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+
+def test_segment_ids_block_diagonal_attention():
+    """Two episodes packed into one row with segment_ids + per-segment
+    positions reproduce the separate-row logprobs — no cross-episode
+    attention leaks."""
+    cfg = tiny_cfg()
+    model = LMWithValueHead(cfg, branch_layer=2)
+    rng = jax.random.PRNGKey(2)
+    a = jax.random.randint(rng, (6,), 0, cfg.vocab_size)
+    b = jax.random.randint(jax.random.PRNGKey(3), (4,), 0, cfg.vocab_size)
+    ids = jnp.zeros((2, 6), jnp.int32).at[0, :6].set(a).at[1, :4].set(b)
+    mask = jnp.asarray([[1] * 6, [1] * 4 + [0] * 2], jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+    sep = model.apply({"params": params}, ids, mask)
+
+    packed = jnp.concatenate([a, b])[None]
+    seg = jnp.asarray([[1] * 6 + [2] * 4])
+    pos = jnp.asarray([list(range(6)) + list(range(4))])
+    out = model.apply(
+        {"params": params},
+        packed,
+        jnp.ones((1, 10), jnp.int32),
+        position_ids=pos,
+        segment_ids=seg,
+    )
+    got = np.asarray(out["logits"])
+    want = np.asarray(sep["logits"])
+    np.testing.assert_allclose(got[0, :6], want[0, :6], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[0, 6:], want[1, :4], rtol=1e-5, atol=1e-5)

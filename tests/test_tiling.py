@@ -118,3 +118,80 @@ def test_routing_probe_refuses_illegal_layout(monkeypatch):
         assert not da.decode_attn_supported(4, 64, 4, 128, True)
         assert not w
     da._PROBE_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# Fused logprob head kernel (ops/fused_logprob.py)
+# ---------------------------------------------------------------------------
+
+from trlx_tpu.ops.tiling import fused_logprob_block_layout
+
+# The flagship bench HEAD shape: gptj-l8-d4096-2.0B trains with 8 rows of
+# T=832 per step (N = 8*832 = 6656 flattened states), d_model 4096, and the
+# GPT-J vocab of 50400 (NOT 512-divisible: the last bv=512 vocab tile is a
+# partial 224-wide block, masked in-kernel).
+HEAD_N, HEAD_D, HEAD_V = 8 * BENCH_T, 4096, 50400
+
+
+@pytest.mark.parametrize("tied,has_bias", [(True, False), (False, False), (False, True)])
+def test_fused_logprob_layout_legal_at_bench_head_shape(tied, has_bias):
+    layouts = fused_logprob_block_layout(
+        HEAD_N, HEAD_D, HEAD_V, 128, 512, tied, has_bias
+    )
+    check_layout(layouts)  # raises TileError on violation
+    # the weight streams in vocab tiles — it must never be the full [V, D]
+    w = next(l for l in layouts if l.name == "w")
+    assert w.block_shape != w.array_shape
+
+
+def test_fused_logprob_layout_rejects_unaligned_vocab_tile():
+    # bv=100: lane dim neither 128-divisible nor the full V — Mosaic would
+    # reject this at lowering; the static check must catch it on CPU.
+    with pytest.raises(TileError):
+        check_layout(
+            fused_logprob_block_layout(HEAD_N, HEAD_D, HEAD_V, 128, 100, False, False)
+        )
+    # bn=4: sublane dim of the hidden block violates the 8-row rule.
+    with pytest.raises(TileError):
+        check_layout(
+            fused_logprob_block_layout(HEAD_N, HEAD_D, HEAD_V, 4, 512, True, False)
+        )
+
+
+def test_fused_probe_refuses_illegal_layout(monkeypatch):
+    """fused_logprob_supported answers False (with a warning, once) when the
+    static layout check fails — the model's head routing keys off this
+    instead of crashing in Mosaic mid-train."""
+    import warnings
+
+    from trlx_tpu.ops import fused_logprob as fl
+    from trlx_tpu.ops import tiling
+
+    def bad_layout(N, D, V, bn, bv, tied, has_bias):
+        return [BlockLayout("x", (4, D), (N, D))]
+
+    fl._PROBE_CACHE.clear()
+    monkeypatch.setattr(tiling, "fused_logprob_block_layout", bad_layout)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert not fl.fused_logprob_supported(256, 128, 1024, False, False)
+        assert any("falling back to the log_softmax" in str(x.message) for x in w)
+    # cached: the next call must not warn again
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert not fl.fused_logprob_supported(256, 128, 1024, False, False)
+        assert not w
+    fl._PROBE_CACHE.clear()
+
+
+def test_fused_logprob_eligibility_is_static():
+    from trlx_tpu.ops.fused_logprob import BLOCK_V, fused_logprob_eligible
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    # the flagship head qualifies wherever a TPU is attached
+    assert fused_logprob_eligible(HEAD_D, HEAD_V) == on_tpu
+    # sub-block vocabs and unaligned d_model never qualify
+    assert not fused_logprob_eligible(HEAD_D, BLOCK_V - 1)
+    assert not fused_logprob_eligible(HEAD_D + 1, HEAD_V)

@@ -126,6 +126,44 @@ class PPORLBatch:
 
 @_register_pytree
 @dataclass
+class PackedPPOBatch:
+    """A PPO train batch with variable-length episodes packed into dense
+    rows (pipeline.ppo_pipeline.pack_ppo_batch; gated by
+    method.pack_train_batch).
+
+    All arrays [rows, W] where W = query_len + response_len and
+    rows <= batch_size (bucketed so retraces stay bounded):
+
+    input_ids/attention_mask: packed valid tokens, right-padded with pad.
+    segment_ids: 1-based episode id per token, 0 at padding — drives the
+       block-diagonal attention bias and the GAE reset.
+    position_ids: per-episode positions (restart at 0 each segment).
+    labels: next-token id at every position (garbage where loss_mask == 0).
+    loss_mask: 1 exactly at response STATE positions — where the policy's
+       next-token distribution scores a response token.
+    old_logprobs/old_values/rewards: rollout stats scattered to the state
+       positions (zero elsewhere).
+    n_seqs: host int — episodes packed in (== train batch_size), the
+       normalizer for per-sequence stats.
+    extras: host-side metadata (fill fraction, token counts); stripped by
+       the trainer before put_batch like PPORLBatch.extras.
+    """
+
+    input_ids: Any
+    attention_mask: Any
+    segment_ids: Any
+    position_ids: Any
+    labels: Any
+    loss_mask: Any
+    old_logprobs: Any
+    old_values: Any
+    rewards: Any
+    n_seqs: Any = None
+    extras: Any = None
+
+
+@_register_pytree
+@dataclass
 class ILQLElement:
     """One offline ILQL sample (reference: trlx/data/ilql_types.py:6-27)."""
 
@@ -161,6 +199,7 @@ __all__ = [
     "PromptBatch",
     "PPORLElement",
     "PPORLBatch",
+    "PackedPPOBatch",
     "ILQLElement",
     "ILQLBatch",
     "RewardFn",

@@ -102,6 +102,13 @@ class PPOConfig(MethodConfig):
     # stages on device ahead of the running train step (when the pipeline is
     # enabled).
     prefetch_depth: int = 1
+    # pack_train_batch: pack the variable-length episodes of each train batch
+    # into dense rows (pipeline.ppo_pipeline.pack_ppo_batch) — fewer padded
+    # positions through the train forward/backward, so short-response
+    # workloads stop paying full [batch, P+R] compute. Row counts are
+    # bucketed (B/4, B/2, 3B/4, B) to bound retraces. Off (the default)
+    # keeps the unpacked per-episode-row layout byte-identical to before.
+    pack_train_batch: bool = False
 
 
 @dataclass

@@ -74,6 +74,9 @@ class LMWithValueHead(nn.Module):
         prepend_soft: bool = True,
         logits_start: int = 0,
         compute_logits: bool = True,
+        labels=None,
+        labels_mask=None,
+        segment_ids=None,
     ):
         out = self.transformer(
             input_ids=input_ids,
@@ -87,6 +90,9 @@ class LMWithValueHead(nn.Module):
             prepend_soft=prepend_soft,
             logits_start=logits_start,
             compute_logits=compute_logits,
+            labels=labels,
+            labels_mask=labels_mask,
+            segment_ids=segment_ids,
         )
         values = self.v_head(out["hidden"])[..., 0]
         return {
@@ -95,21 +101,33 @@ class LMWithValueHead(nn.Module):
             "hidden": out["hidden"],
             "branch_hidden": out["branch_hidden"],
             "cache": out["cache"],
+            "logprobs": out["logprobs"],
+            "lse": out["lse"],
+            "entropy": out["entropy"],
         }
 
-    def forward_branch(self, branch_hidden, attention_mask=None, position_ids=None, logits_start: int = 0):
+    def forward_branch(self, branch_hidden, attention_mask=None, position_ids=None, logits_start: int = 0,
+                       labels=None, labels_mask=None, segment_ids=None):
         """Replay blocks [branch_layer..N) + ln_f + lm head from the
         branch-point hidden states. Called via
         ``model.apply({'params': ref_branch_params}, ..., method='forward_branch')``
         — the functional `forward_hydra`
-        (reference: trlx/model/nn/ppo_models.py:351-368)."""
+        (reference: trlx/model/nn/ppo_models.py:351-368). With ``labels``
+        the replay returns fp32 label logprobs [b, S] straight from the
+        fused head (the ref branch's [b, S, V] logits never materialize);
+        without, it returns logits as before."""
         out = self.transformer(
             inputs_embeds=branch_hidden,
             attention_mask=attention_mask,
             position_ids=position_ids,
             start_layer=self.branch_layer,
             logits_start=logits_start,
+            labels=labels,
+            labels_mask=labels_mask,
+            segment_ids=segment_ids,
         )
+        if labels is not None:
+            return out["logprobs"]
         return out["logits"]
 
 
@@ -145,12 +163,22 @@ class LMWithILQLHeads(nn.Module):
         cache_index=None,
         cache_mask=None,
         prepend_soft: bool = True,
+        labels=None,
+        labels_mask=None,
+        compute_q_heads: bool = True,
     ):
-        """Returns dict(logits, qs, vs, hidden, cache).
+        """Returns dict(logits, qs, vs, hidden, cache, logprobs).
 
         With states_ixs/actions_ixs [b, n]: Q heads run only on action hidden
         states, V head on state hidden states (reference:
         trlx/model/nn/ilql_models.py:99-118). Without: all positions.
+
+        ``labels`` switches the LM head to the fused-logprob mode (logits
+        stays None, ``logprobs`` [b, S] comes back instead — the AWAC term
+        without a [b, T, V] buffer). ``compute_q_heads=False`` skips the
+        vocab-wide online Q projection (qs = None): the fused trainer path
+        evaluates the Q heads itself through the streaming kernel, so the
+        [b, A, V] tensors never materialize either.
         """
         out = self.transformer(
             input_ids=input_ids,
@@ -160,6 +188,8 @@ class LMWithILQLHeads(nn.Module):
             cache_index=cache_index,
             cache_mask=cache_mask,
             prepend_soft=prepend_soft,
+            labels=labels,
+            labels_mask=labels_mask,
         )
         hs = out["hidden"]
         if actions_ixs is not None:
@@ -171,7 +201,7 @@ class LMWithILQLHeads(nn.Module):
         else:
             hs_states = hs
 
-        qs = self.compute_qs(hs_actions)
+        qs = self.compute_qs(hs_actions) if compute_q_heads else None
         vs = self.v_head(hs_states)[..., 0]
         return {
             "logits": out["logits"],
@@ -179,6 +209,7 @@ class LMWithILQLHeads(nn.Module):
             "vs": vs,
             "hidden": hs,
             "cache": out["cache"],
+            "logprobs": out["logprobs"],
         }
 
     def compute_qs(self, hidden) -> Tuple[jnp.ndarray, ...]:

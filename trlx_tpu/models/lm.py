@@ -261,6 +261,34 @@ class QDense(nn.Module):
         return y
 
 
+class HeadParams(nn.Module):
+    """Declares the SAME parameters as QDense(name='lm_head') — identical
+    names ('kernel'/'bias'), shapes, dtypes, and initializers — but returns
+    the raw arrays instead of applying the projection. The fused-logprob
+    head path (TransformerLM labels mode) streams the weight through the
+    Pallas kernel itself; the param tree stays byte-compatible with the
+    materializing path, so checkpoints and init are interchangeable."""
+
+    features: int
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (in_features, self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
 QUANT_KERNEL_NAMES = ("c_qkv", "q_proj", "k_proj", "v_proj", "c_proj", "c_fc", "lm_head")
 
 
@@ -478,7 +506,13 @@ class Block(nn.Module):
         return x, new_cache
 
 
-def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset, window: int = 0) -> jnp.ndarray:
+def make_attn_bias(
+    attn_mask_kv: jnp.ndarray,
+    q_len: int,
+    q_offset,
+    window: int = 0,
+    segment_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
     """Build the additive attention bias [b, 1, q_len, kv_len].
 
     attn_mask_kv: [b, kv_len] validity of each key slot (handles left padding
@@ -487,6 +521,11 @@ def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset, window: int 
     Causality is by buffer index: key j visible to query i iff j <= q_offset+i;
     `window > 0` additionally requires j > q_offset+i−window (gpt-neo local
     attention layers).
+
+    ``segment_ids`` [b, q_len] (packed train batches, full-sequence passes
+    only — q_len == kv_len) additionally makes the bias block-diagonal: a
+    key is visible only to queries of the SAME packed segment, so the
+    sequences packed into one row cannot attend across each other.
     """
     kv_len = attn_mask_kv.shape[-1]
     q_idx = q_offset + jnp.arange(q_len)[:, None]
@@ -495,6 +534,9 @@ def make_attn_bias(attn_mask_kv: jnp.ndarray, q_len: int, q_offset, window: int 
     if window > 0:
         causal = causal & (k_idx > q_idx - window)
     valid = attn_mask_kv[:, None, None, :].astype(bool) & causal[None, None, :, :]
+    if segment_ids is not None:
+        same_seg = segment_ids[:, None, None, :] == segment_ids[:, None, :, None]
+        valid = valid & same_seg
     return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
 
 
@@ -525,6 +567,9 @@ class TransformerLM(nn.Module):
         compute_logits: bool = True,
         logits_start: int = 0,
         prepend_soft: bool = True,
+        labels: Optional[jnp.ndarray] = None,
+        labels_mask: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
         """Returns dict(logits, hidden, branch_hidden, cache).
 
@@ -534,9 +579,25 @@ class TransformerLM(nn.Module):
         - `collect_hidden_at=k` also returns the hidden state entering block k
           (the hydra branch point, reference:
           trlx/model/nn/ppo_models.py:351-368's `forward_hydra` hidden pick).
+        - `labels` [b, S] switches the head to the fused-logprob mode: instead
+          of materializing [b, S, V] logits, the result dict carries fp32
+          ``logprobs``/``lse``/``entropy`` [b, S] — label logprob, logsumexp,
+          and entropy at positions logits_start..logits_start+S-1 — computed
+          by the vocab-streaming Pallas kernel when eligible (see
+          trlx_tpu.ops.fused_logprob; LMConfig.extra['fused_logprob'] ∈
+          auto|force|off) and by the exact materializing log_softmax chain
+          otherwise. ``labels_mask`` zeros masked rows on either path.
+          ``logits`` is None in this mode: not existing is the point.
+        - `segment_ids` [b, q_len] (packed train batches; full-sequence
+          passes only) makes attention block-diagonal per packed segment —
+          the einsum bias path is forced, since the flash/ring kernels'
+          masks cannot express segments.
         """
         cfg = self.cfg
         stop_layer = cfg.n_layer if stop_layer is None else stop_layer
+        assert segment_ids is None or cache is None, (
+            "segment packing is a train-batch construct; decode caches are unpacked"
+        )
 
         wte = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="wte"
@@ -609,6 +670,10 @@ class TransformerLM(nn.Module):
             and int(cache_index) == 0
         )
         use_flash = use_ring or flash_eligible(cfg, q_len, cache is not None, prefill_at_zero)
+        if segment_ids is not None:
+            # Packed segments need a block-diagonal mask; the flash/ring
+            # kernels' (causal × key-validity) masks cannot express that.
+            use_ring = use_flash = False
         if use_flash:
             attn_bias = local_bias = None
             flash_mask = attention_mask.astype(jnp.float32)
@@ -619,10 +684,12 @@ class TransformerLM(nn.Module):
                 bias_mask, bias_offset = kv_mask, cache_index
             else:
                 bias_mask, bias_offset = attention_mask, 0
-            attn_bias = make_attn_bias(bias_mask, q_len, bias_offset)
+            attn_bias = make_attn_bias(bias_mask, q_len, bias_offset, segment_ids=segment_ids)
             local_bias = None
             if any(t == "local" for t in cfg.attention_layers):
-                local_bias = make_attn_bias(bias_mask, q_len, bias_offset, window=cfg.window_size)
+                local_bias = make_attn_bias(
+                    bias_mask, q_len, bias_offset, window=cfg.window_size, segment_ids=segment_ids
+                )
 
         block_cls = Block
         if cfg.remat:
@@ -672,7 +739,38 @@ class TransformerLM(nn.Module):
                 branch_hidden = branch_hidden[:, n_soft:]
 
         logits = None
-        if compute_logits:
+        logprobs = lse = entropy = None
+        if labels is not None:
+            # Fused head mode: the [b, S, V] logits are never materialized —
+            # the vocab projection streams through the Pallas kernel (or the
+            # exact log_softmax chain when ineligible). The label length S
+            # selects how many head positions are evaluated: callers that
+            # previously computed logits[:, :-1] simply pass S = len-1 labels.
+            from trlx_tpu.ops.fused_logprob import routed_logprob
+
+            S = labels.shape[1]
+            x_head = x[:, logits_start:] if logits_start else x
+            x_head = x_head[:, :S]
+            if cfg.tie_word_embeddings:
+                w_head, b_head, tied = wte.embedding, None, True
+            else:
+                w_head, b_head = HeadParams(
+                    cfg.vocab_size,
+                    param_dtype=cfg.params_dtype,
+                    use_bias=cfg.extra.get("lm_head_bias", False),
+                    name="lm_head",
+                )(x_head.shape[-1])
+                tied = False
+            logprobs, lse, entropy = routed_logprob(
+                x_head,
+                w_head,
+                labels,
+                b_head,
+                tied=tied,
+                mode=cfg.extra.get("fused_logprob", "auto"),
+                mask=labels_mask,
+            )
+        elif compute_logits:
             # RL losses/scoring only need logits from the first response
             # position on — slicing before the head skips ~P/T of the
             # vocab-projection FLOPs and the fp32 logit memory.
@@ -693,6 +791,9 @@ class TransformerLM(nn.Module):
             "hidden": x,
             "branch_hidden": branch_hidden,
             "cache": tuple(new_cache) if new_cache is not None else None,
+            "logprobs": logprobs,
+            "lse": lse,
+            "entropy": entropy,
         }
 
 

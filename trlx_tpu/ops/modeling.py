@@ -58,16 +58,33 @@ def clip_by_value(x: jnp.ndarray, tensor_min, tensor_max) -> jnp.ndarray:
     return jnp.clip(x, tensor_min, tensor_max)
 
 
-def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def logprobs_from_logits(
+    logits: jnp.ndarray, labels: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
     """Per-token log-probabilities of ``labels`` under ``logits``
     (reference: trlx/utils/modeling.py:23-29).
 
     logits: [..., vocab]; labels: [...] int. Softmax runs in float32 for
     numerical stability regardless of the compute dtype (bf16 matmuls feed
     fp32 log-softmax — standard TPU practice).
+
+    ``mask`` (optional, [...] like labels): rows with mask == 0 are skipped —
+    their logits are zeroed before the softmax (so garbage/-inf padding rows
+    cannot emit NaN) and the returned logprob is exactly 0 there. Every
+    caller multiplies by the same mask downstream, so with a valid mask the
+    masked-row values were always discarded; passing it here just makes the
+    skip explicit and the fallback path pad-safe. Default (no mask) is
+    unchanged.
     """
-    logp = jnn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        keep = mask.astype(bool)[..., None]
+        logits = jnp.where(keep, logits, 0.0)
+    logp = jnn.log_softmax(logits, axis=-1)
+    out = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        out = out * mask.astype(jnp.float32)
+    return out
 
 
 def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -91,8 +108,13 @@ def gather_hidden_at(hidden: jnp.ndarray, ixs: jnp.ndarray) -> jnp.ndarray:
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
-    """Mean token cross-entropy with optional mask (fp32 accumulation)."""
-    nll = -logprobs_from_logits(logits, labels)
+    """Mean token cross-entropy with optional mask (fp32 accumulation).
+
+    The mask is passed through to logprobs_from_logits, so masked rows are
+    skipped in the softmax too (masked_mean already excluded them from the
+    reduction; the pass-through keeps non-finite padding rows from ever
+    entering the log_softmax)."""
+    nll = -logprobs_from_logits(logits, labels, mask)
     if mask is None:
         return jnp.mean(nll)
     return masked_mean(nll, mask)

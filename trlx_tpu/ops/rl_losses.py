@@ -32,6 +32,7 @@ def gae_advantages(
     mask: jnp.ndarray,
     gamma: float,
     lam: float,
+    segment_ids: jnp.ndarray = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Generalized advantage estimation over the response region.
 
@@ -39,18 +40,45 @@ def gae_advantages(
     both zeroed at padded positions. The reversed recurrence
     A_t = delta_t + gamma*lam*A_{t+1} runs as a `lax.scan` over reversed time
     — one compiled pass instead of the reference's per-step Python loop.
+
+    ``segment_ids`` (optional, [b, R] int, 0 = pad): with packed rows holding
+    several independent episodes per row, both the bootstrap V(s_{t+1}) and
+    the scan carry must stop at segment boundaries — each packed episode gets
+    exactly the recurrence it would get unpacked. Without it (the default)
+    the function is unchanged: one episode per row, boundary handled by the
+    zero-padded tail.
     """
     mask = mask.astype(jnp.float32)
     r = rewards.astype(jnp.float32) * mask
     v = values.astype(jnp.float32) * mask
     next_v = jnp.concatenate([v[:, 1:], jnp.zeros_like(v[:, :1])], axis=1)
+    if segment_ids is not None:
+        # cont[t] = 1 iff t+1 is a valid token of the SAME episode; kills the
+        # bootstrap and the lam-carry across packed-episode boundaries.
+        same = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (mask[:, 1:] > 0)
+        cont = jnp.concatenate(
+            [same.astype(jnp.float32), jnp.zeros_like(mask[:, :1])], axis=1
+        )
+        next_v = next_v * cont
     deltas = r + gamma * next_v - v  # zero at padded tail ⇒ clean boundary
 
-    def step(carry, delta_t):
-        adv_t = delta_t + gamma * lam * carry
-        return adv_t, adv_t
+    if segment_ids is None:
 
-    _, advs_rev = jax.lax.scan(step, jnp.zeros_like(deltas[:, 0]), deltas.T[::-1])
+        def step(carry, delta_t):
+            adv_t = delta_t + gamma * lam * carry
+            return adv_t, adv_t
+
+        _, advs_rev = jax.lax.scan(step, jnp.zeros_like(deltas[:, 0]), deltas.T[::-1])
+    else:
+
+        def step(carry, xs):
+            delta_t, cont_t = xs
+            adv_t = delta_t + gamma * lam * carry * cont_t
+            return adv_t, adv_t
+
+        _, advs_rev = jax.lax.scan(
+            step, jnp.zeros_like(deltas[:, 0]), (deltas.T[::-1], cont.T[::-1])
+        )
     advantages = advs_rev[::-1].T * mask
     returns = (advantages + v) * mask
     return advantages, returns
@@ -69,6 +97,8 @@ def ppo_loss(
     cliprange: float,
     cliprange_value: float,
     vf_coef: float,
+    segment_ids: jnp.ndarray = None,
+    n_seqs: int = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped PPO objective over the response region
     (reference: trlx/model/accelerate_ppo_model.py:76-155).
@@ -78,9 +108,19 @@ def ppo_loss(
     Returns (loss, stats); stats["mean_kl"] is the policy-vs-rollout
     sum-over-tokens KL the adaptive controller consumes (the same quantity the
     reference records at trlx/model/accelerate_ppo_model.py:134-136).
+
+    Packed batches: pass ``segment_ids`` ([b, R] int, 0 = pad — forwarded to
+    GAE so the recurrence resets at episode boundaries) and ``n_seqs`` (static
+    int: the number of ORIGINAL episodes packed into the batch). The
+    token-level reductions (masked_mean over valid tokens) are already
+    layout-invariant; only the per-sequence means (mean_kl, mean_return) need
+    n_seqs — row count no longer equals episode count. Defaults keep the
+    unpacked path byte-identical.
     """
     mask = mask.astype(jnp.float32)
-    advantages, returns = gae_advantages(rewards, old_values, mask, gamma, lam)
+    advantages, returns = gae_advantages(
+        rewards, old_values, mask, gamma, lam, segment_ids=segment_ids
+    )
     advantages = jax.lax.stop_gradient(masked_whiten(advantages, mask))
     returns = jax.lax.stop_gradient(returns)
 
@@ -99,15 +139,23 @@ def ppo_loss(
     pg_clipfrac = masked_mean((pg_losses2 > pg_losses).astype(jnp.float32), mask)
 
     loss = pg_loss + vf_coef * vf_loss
+    if n_seqs is None:
+        mean_kl = jnp.mean(jnp.sum(log_ratio, axis=-1))
+        mean_return = jnp.mean(jnp.sum(rewards * mask, axis=-1))
+    else:
+        # Packed: per-episode sums still add up across rows, but rows != episodes,
+        # so normalize by the true episode count instead of jnp.mean's row count.
+        mean_kl = jnp.sum(log_ratio) / n_seqs
+        mean_return = jnp.sum(rewards * mask) / n_seqs
     stats = {
         "loss": loss,
         "pg_loss": pg_loss,
         "vf_loss": vf_loss,
         "pg_clipfrac": pg_clipfrac,
         "vf_clipfrac": vf_clipfrac,
-        "mean_kl": jnp.mean(jnp.sum(log_ratio, axis=-1)),
+        "mean_kl": mean_kl,
         "mean_ratio": masked_mean(ratio, mask),
-        "mean_return": jnp.mean(jnp.sum(rewards * mask, axis=-1)),
+        "mean_return": mean_return,
         "mean_advantage": masked_mean(advantages, mask),
     }
     return loss, stats

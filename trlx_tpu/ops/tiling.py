@@ -137,3 +137,29 @@ def flash_block_layout(BH: int, T: int, D: int, bq: int, bk: int) -> list:
         BlockLayout("o", (1, bq, D), (BH, T, D)),
         BlockLayout("lse", (1, 1, bq), (BH, 1, T)),
     ]
+
+
+def fused_logprob_block_layout(
+    N: int, D: int, V: int, bn: int, bv: int, tied: bool, has_bias: bool
+) -> list:
+    """The fused vocab-projection/logprob kernel's forward block layouts (see
+    trlx_tpu.ops.fused_logprob: grid (N-blocks, V-blocks), the hidden block
+    carries the full [D] model axis, the weight streams in bv-wide vocab
+    tiles, labels/outputs are [N, 1] columns whose width-1 last dim equals
+    the array dim — legal without lane tiling). `tied` flips the weight
+    between the untied lm_head kernel [D, V] and the embedding table [V, D].
+    The V axis may be ragged (GPT-2/J vocabs are not 128-divisible): the
+    bv-divisible tail block is partial and masked in-kernel, exactly like
+    the flash-decode T tail."""
+    w = BlockLayout("w", (bv, D), (V, D)) if tied else BlockLayout("w", (D, bv), (D, V))
+    layouts = [
+        BlockLayout("x", (bn, D), (N, D)),
+        w,
+        BlockLayout("labels", (bn, 1), (N, 1)),
+        BlockLayout("logprob", (bn, 1), (N, 1)),
+        BlockLayout("lse", (bn, 1), (N, 1)),
+        BlockLayout("entropy", (bn, 1), (N, 1)),
+    ]
+    if has_bias:
+        layouts.insert(2, BlockLayout("bias", (1, bv), (1, V)))
+    return layouts

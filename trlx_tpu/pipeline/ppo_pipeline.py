@@ -16,8 +16,114 @@ from typing import Dict, Iterable
 
 import numpy as np
 
-from trlx_tpu.data import PPORLBatch, PPORLElement
+from trlx_tpu.data import PackedPPOBatch, PPORLBatch, PPORLElement
 from trlx_tpu.pipeline import BaseRolloutStore, BatchLoader
+
+
+def _pack_row_buckets(batch_size: int, rows_multiple: int = 1):
+    """Allowed packed row counts: quartiles of the unpacked batch. Every
+    distinct row count is a fresh XLA compile of the train step, so the
+    packer rounds up to one of four shapes instead of emitting exact fits.
+    ``rows_multiple`` is the mesh's data-axis size: put_batch shards the
+    leading dim over (dp, fsdp), so every bucket must divide evenly (the
+    unpacked batch_size is already validated divisible at trainer init)."""
+    m = max(1, rows_multiple)
+    return sorted({-(-max(1, (batch_size * k + 3) // 4) // m) * m for k in (1, 2, 3, 4)})
+
+
+def pack_ppo_batch(
+    g: Dict[str, np.ndarray], pad_token_id: int = 0, rows_multiple: int = 1
+) -> PackedPPOBatch:
+    """Pack B variable-length episodes into dense [rows, P+R] rows.
+
+    ``g`` holds the gathered store columns for one train batch (queries
+    left-padded [B, P], responses right-padded [B, R], per-token stats
+    [B, R]). Each episode's valid tokens (query run + response run) are
+    placed contiguously into the first row with room (first-fit decreasing);
+    ALL B episodes are packed — even empty responses — so the episode count
+    the per-sequence stats normalize by is exactly B.
+
+    Per-token outputs follow the state-before-token convention: the state
+    positions of an episode at row offset ``o`` with ``q`` query / ``r``
+    response tokens are o+q-1 .. o+q+r-2; ``labels`` at a state is the NEXT
+    packed token (the response token that position predicts), and the
+    rollout stats (old logprobs/values/rewards) scatter to the same state
+    positions. Everything outside loss_mask is zero.
+    """
+    q, qm = np.asarray(g["query_tensors"]), np.asarray(g["query_mask"])
+    r, rm = np.asarray(g["response_tensors"]), np.asarray(g["response_mask"])
+    B, P = q.shape
+    R = r.shape[1]
+    W = P + R
+    q_lens = qm.astype(np.int64).sum(axis=1)
+    r_lens = rm.astype(np.int64).sum(axis=1)
+    lens = q_lens + r_lens
+
+    # First-fit decreasing over rows of fixed width W (stable order for ties
+    # so packing is deterministic given the batch).
+    order = np.argsort(-lens, kind="stable")
+    row_used = []
+    placement = {}  # sample -> (row, offset)
+    for i in order:
+        L = int(lens[i])
+        for ro, used in enumerate(row_used):
+            if used + L <= W:
+                placement[i] = (ro, used)
+                row_used[ro] = used + L
+                break
+        else:
+            placement[i] = (len(row_used), 0)
+            row_used.append(L)
+    buckets = _pack_row_buckets(B, rows_multiple)
+    nrows = next(b for b in buckets if b >= len(row_used))
+
+    input_ids = np.full((nrows, W), pad_token_id, dtype=np.int32)
+    attention_mask = np.zeros((nrows, W), dtype=np.int32)
+    segment_ids = np.zeros((nrows, W), dtype=np.int32)
+    position_ids = np.zeros((nrows, W), dtype=np.int32)
+    labels = np.zeros((nrows, W), dtype=np.int32)
+    loss_mask = np.zeros((nrows, W), dtype=np.int32)
+    old_logprobs = np.zeros((nrows, W), dtype=np.float32)
+    old_values = np.zeros((nrows, W), dtype=np.float32)
+    rewards = np.zeros((nrows, W), dtype=np.float32)
+
+    for i in range(B):
+        ro, o = placement[i]
+        ql, rl = int(q_lens[i]), int(r_lens[i])
+        toks = np.concatenate([q[i, P - ql :] if ql else q[i, :0], r[i, :rl]])
+        L = ql + rl
+        input_ids[ro, o : o + L] = toks
+        attention_mask[ro, o : o + L] = 1
+        segment_ids[ro, o : o + L] = i + 1
+        position_ids[ro, o : o + L] = np.arange(L)
+        if rl and ql:
+            s0 = o + ql - 1  # first state: predicts the first response token
+            labels[ro, s0 : s0 + rl] = toks[ql : ql + rl]
+            loss_mask[ro, s0 : s0 + rl] = 1
+            old_logprobs[ro, s0 : s0 + rl] = g["logprobs"][i, :rl]
+            old_values[ro, s0 : s0 + rl] = g["values"][i, :rl]
+            rewards[ro, s0 : s0 + rl] = g["rewards"][i, :rl]
+
+    extras = {
+        "pack_fill": float(attention_mask.sum()) / float(nrows * W),
+        "batch_tokens": int(nrows * W),
+        "n_seqs": B,
+    }
+    if "staleness" in g:
+        extras["staleness"] = np.asarray(g["staleness"])[:, 0]
+    return PackedPPOBatch(
+        input_ids=input_ids,
+        attention_mask=attention_mask,
+        segment_ids=segment_ids,
+        position_ids=position_ids,
+        labels=labels,
+        loss_mask=loss_mask,
+        old_logprobs=old_logprobs,
+        old_values=old_values,
+        rewards=rewards,
+        n_seqs=None,  # static: the trainer uses config.train.batch_size
+        extras=extras,
+    )
 
 _FIELD_SPECS = (
     ("query_tensors", "P", np.int32),
@@ -103,12 +209,22 @@ class PPORolloutStorage(BaseRolloutStore):
             query_mask=g["query_mask"][0],
         )
 
-    def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> BatchLoader:
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        pack: bool = False,
+        rows_multiple: int = 1,
+    ) -> BatchLoader:
         buffer = self._buffer
         record_staleness = self.record_staleness
+        pad_token_id = self.pad_token_id
 
         def collate(ixs):
             g = buffer.gather(np.asarray(ixs))
+            if pack:
+                return pack_ppo_batch(g, pad_token_id, rows_multiple)
             extras = None
             if record_staleness:
                 # Host-side batch metadata: the trainer strips it before

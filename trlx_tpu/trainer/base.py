@@ -487,6 +487,8 @@ class JaxBaseTrainer(BaseRLTrainer):
             ("metrics/optimality", "optimality"),
             ("samples_per_sec", "samples/s"),
             ("exp_per_sec", "exp/s"),
+            ("train_tokens_per_s", "tok/s"),
+            ("train_batch_fill", "fill"),
         ):
             if key in merged:
                 parts.append(f"{label}={merged[key]:.4g}")

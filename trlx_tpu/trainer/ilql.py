@@ -22,8 +22,9 @@ import optax
 from trlx_tpu.data import ILQLBatch
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import LMWithILQLHeads
+from trlx_tpu.ops.fused_logprob import fused_logprob_eligible, routed_logprob
 from trlx_tpu.ops.generate import make_generate_fn
-from trlx_tpu.ops.ilql_loss import ilql_loss
+from trlx_tpu.ops.ilql_loss import action_tokens, ilql_loss, ilql_loss_terms
 from trlx_tpu.ops.modeling import topk_mask
 from trlx_tpu.ops.sampling import NEG_INF, GenerateConfig
 from trlx_tpu.resilience.guard import guarded_update
@@ -174,8 +175,87 @@ class ILQLTrainer(JaxBaseTrainer):
         model = self.model
         optimizer = self.optimizer
         schedule = self.schedule
+        cfg = model.cfg
+        fused_mode = cfg.extra.get("fused_logprob", "auto")
+        # Static branch: the fused path changes which tensors exist in the
+        # step (no [b, T, V] logits, no [b, A, V] online Q), so the decision
+        # is made at build time. "auto" adopts it only where the kernel is
+        # actually eligible (TPU, aligned d_model, big vocab); CPU/default
+        # keeps the pre-fusion loss verbatim.
+        use_fused = fused_mode == "force" or (
+            fused_mode == "auto" and fused_logprob_eligible(cfg.d_model, cfg.vocab_size)
+        )
+        compute_dtype = cfg.compute_dtype
 
-        def loss_fn(params, extras, batch: ILQLBatch):
+        def mlp_hidden(head, x):
+            # MLPHead.layers_0 + relu over raw param arrays (byte-matching
+            # nn.Dense(dtype=compute_dtype): inputs/kernel/bias cast, then
+            # x @ k + b).
+            k0 = head["layers_0"]["kernel"].astype(compute_dtype)
+            b0 = head["layers_0"]["bias"].astype(compute_dtype)
+            return jax.nn.relu(jnp.dot(x.astype(compute_dtype), k0) + b0)
+
+        def gathered_head_logit(head, x, actions):
+            # Target heads only ever feed TD targets at the dataset action —
+            # a [D2]-column gather of layers_1 beats projecting all V logits.
+            h = mlp_hidden(head, x).astype(jnp.float32)
+            k1 = head["layers_1"]["kernel"].astype(jnp.float32)  # [D2, V]
+            b1 = head["layers_1"]["bias"].astype(jnp.float32)
+            w = jnp.take(k1.T, actions, axis=0)  # [b, A, D2]
+            return jnp.sum(h * w, axis=-1) + b1[actions]
+
+        def fused_loss_fn(params, extras, batch: ILQLBatch):
+            params = self.detach_frozen(params)
+            labels = batch.input_ids[:, 1:]
+            attn1 = batch.attention_mask[:, 1:]
+            out = model.apply(
+                {"params": params},
+                batch.input_ids,
+                batch.attention_mask,
+                states_ixs=batch.states_ixs,
+                actions_ixs=batch.actions_ixs,
+                labels=labels,
+                labels_mask=attn1,
+                compute_q_heads=False,
+            )
+            # AWAC straight from the fused LM head (out["logprobs"] is fp32,
+            # zeroed at masked rows).
+            attn = attn1.astype(jnp.float32)
+            loss_awac = jnp.sum(-out["logprobs"] * attn) / jnp.maximum(jnp.sum(attn), 1.0)
+
+            hs_actions = jnp.take_along_axis(out["hidden"], batch.actions_ixs[..., None], axis=1)
+            actions = action_tokens(batch.input_ids, batch.actions_ixs)
+            head_names = ["q1_head"] + (["q2_head"] if m.two_qs else [])
+            Qs, cql_nlls = [], []
+            for name in head_names:
+                head = params[name]
+                lp, lse, _ = routed_logprob(
+                    mlp_hidden(head, hs_actions).astype(jnp.float32),
+                    head["layers_1"]["kernel"],
+                    actions,
+                    head["layers_1"]["bias"],
+                    tied=False,
+                    mode=fused_mode,
+                )
+                # gathered Q at the action = label logit = logprob + logsumexp
+                Qs.append(lp + lse)
+                cql_nlls.append(-lp)
+            targetQs = [gathered_head_logit(extras[name], hs_actions, actions) for name in head_names]
+            return ilql_loss_terms(
+                Qs,
+                targetQs,
+                cql_nlls,
+                out["vs"],
+                batch.rewards,
+                batch.dones,
+                loss_awac,
+                gamma=m.gamma,
+                tau=m.tau,
+                cql_scale=m.cql_scale,
+                awac_scale=m.awac_scale,
+            )
+
+        def dense_loss_fn(params, extras, batch: ILQLBatch):
             params = self.detach_frozen(params)
             out = model.apply(
                 {"params": params},
@@ -201,6 +281,8 @@ class ILQLTrainer(JaxBaseTrainer):
                 cql_scale=m.cql_scale,
                 awac_scale=m.awac_scale,
             )
+
+        loss_fn = fused_loss_fn if use_fused else dense_loss_fn
 
         def train_step(state, batch: ILQLBatch):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, state.extras, batch)

@@ -15,18 +15,35 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from trlx_tpu.data import PPORLBatch
+from trlx_tpu.data import PackedPPOBatch, PPORLBatch
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import LMWithValueHead, extract_branch_params
+from trlx_tpu.ops.fused_logprob import fused_logprob_eligible
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.modeling import logprobs_from_logits
 from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
 from trlx_tpu.ops.sampling import GenerateConfig
+from trlx_tpu.parallel.mesh import DATA_AXES
 from trlx_tpu.pipeline.overlap import PhaseTimer, RolloutProducer
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.resilience.guard import guarded_update
 from trlx_tpu.trainer import register_model
 from trlx_tpu.trainer.base import JaxBaseTrainer
+
+
+def resolve_fused_head(cfg) -> bool:
+    """Static decision: route the LM-head logprob passes through the fused
+    streaming kernel (trlx_tpu/ops/fused_logprob.py) instead of the
+    materialize-logits + log_softmax chain. "force" always adopts (the
+    router still falls back to the exact naive path per-shape); "auto"
+    adopts only where the kernel is structurally eligible — on CPU/default
+    configs this is False, keeping every default code path verbatim
+    pre-fusion. The decision changes which tensors EXIST in the jitted
+    programs, so it is made at build time, never in-trace."""
+    mode = cfg.extra.get("fused_logprob", "auto")
+    if mode == "force":
+        return True
+    return mode == "auto" and fused_logprob_eligible(cfg.d_model, cfg.vocab_size)
 
 
 class AdaptiveKLController:
@@ -70,6 +87,15 @@ class PPOTrainer(JaxBaseTrainer):
         # boundary param snapshot while training runs — bounded off-policy.
         self.max_staleness = max(0, int(getattr(m, "max_staleness", 0) or 0))
         self.overlap_rollouts = bool(getattr(m, "rollout_overlap", False)) or self.max_staleness > 0
+        # Packed train batches (pipeline.ppo_pipeline.pack_ppo_batch) +
+        # train-throughput metering for the phase window (satellite of the
+        # fused-logprob head work; see make_ppo_train_step).
+        self._pack_train_batch = bool(getattr(m, "pack_train_batch", False))
+        # put_batch shards the leading dim over DATA_AXES — packed row-count
+        # buckets must round up to a multiple of that axis product.
+        self._pack_rows_multiple = int(np.prod([self.mesh.shape[a] for a in DATA_AXES]))
+        self._window_tokens = []
+        self._window_fill = []
         if self.max_staleness > 0 and jax.process_count() > 1:
             # Two threads dispatching device programs concurrently cannot
             # guarantee the same collective launch order on every host — the
@@ -447,10 +473,18 @@ class PPOTrainer(JaxBaseTrainer):
         bh = jnp.concatenate(
             [bh_prefill, bh_steps[:, 1:], jnp.zeros_like(bh_steps[:, :1])], axis=1
         )  # [b, T, d]
-        ref_logits = self.model.apply(
-            {"params": extras}, bh, mask, method="forward_branch", logits_start=P - 1
-        ).astype(jnp.float32)
-        rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
+        if resolve_fused_head(self.model.cfg):
+            # Streaming head: the ref branch's [b, R, V] logits never land in
+            # HBM — forward_branch returns the label logprobs directly.
+            rlp = self.model.apply(
+                {"params": extras}, bh, mask, method="forward_branch",
+                logits_start=P - 1, labels=tokens[:, P:], labels_mask=mask[:, P:],
+            )
+        else:
+            ref_logits = self.model.apply(
+                {"params": extras}, bh, mask, method="forward_branch", logits_start=P - 1
+            ).astype(jnp.float32)
+            rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
         rmask = mask[:, P:]
         rewards, kl = kl_penalty_rewards(logprob, rlp, rmask, scores, kl_coef)
         return logprob, value, rewards, kl
@@ -474,26 +508,49 @@ class PPOTrainer(JaxBaseTrainer):
 
     def _rollout_score_impl(self, params, extras, tokens, mask, scores, kl_coef, *, prompt_length: int):
         P = prompt_length
-        # logits_start=P-1: the vocab projection + fp32 softmax run only over
-        # the response region [P-1, T) — the prompt's logits are never needed.
-        out = self.model.apply(
-            {"params": params}, tokens, mask, collect_branch_hidden=True, logits_start=P - 1
-        )
-        logits = out["logits"].astype(jnp.float32)
-        if self.model.branch_layer >= 0:
-            ref_logits = self.model.apply(
-                {"params": extras}, out["branch_hidden"], mask,
-                method="forward_branch", logits_start=P - 1,
-            ).astype(jnp.float32)
-        else:
-            ref_logits = self.model.apply(
-                {"params": extras}, tokens, mask, logits_start=P - 1
-            )["logits"].astype(jnp.float32)
-
         # Response region, state-before-token convention [P-1, P+R-1)
         # (reference: trlx/orchestrator/ppo_orchestrator.py:94-98).
-        lp = logprobs_from_logits(logits[:, :-1], tokens[:, P:])
-        rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
+        if resolve_fused_head(self.model.cfg):
+            # Fused head on BOTH passes: policy apply and ref replay return
+            # label logprobs straight from the streaming kernel — neither
+            # [b, R, V] logits buffer exists.
+            rlabels, rlmask = tokens[:, P:], mask[:, P:]
+            out = self.model.apply(
+                {"params": params}, tokens, mask, collect_branch_hidden=True,
+                logits_start=P - 1, labels=rlabels, labels_mask=rlmask,
+            )
+            lp = out["logprobs"]
+            if self.model.branch_layer >= 0:
+                rlp = self.model.apply(
+                    {"params": extras}, out["branch_hidden"], mask,
+                    method="forward_branch", logits_start=P - 1,
+                    labels=rlabels, labels_mask=rlmask,
+                )
+            else:
+                rlp = self.model.apply(
+                    {"params": extras}, tokens, mask, logits_start=P - 1,
+                    labels=rlabels, labels_mask=rlmask,
+                )["logprobs"]
+        else:
+            # logits_start=P-1: the vocab projection + fp32 softmax run only
+            # over the response region [P-1, T) — the prompt's logits are
+            # never needed.
+            out = self.model.apply(
+                {"params": params}, tokens, mask, collect_branch_hidden=True, logits_start=P - 1
+            )
+            logits = out["logits"].astype(jnp.float32)
+            if self.model.branch_layer >= 0:
+                ref_logits = self.model.apply(
+                    {"params": extras}, out["branch_hidden"], mask,
+                    method="forward_branch", logits_start=P - 1,
+                ).astype(jnp.float32)
+            else:
+                ref_logits = self.model.apply(
+                    {"params": extras}, tokens, mask, logits_start=P - 1
+                )["logits"].astype(jnp.float32)
+
+            lp = logprobs_from_logits(logits[:, :-1], tokens[:, P:])
+            rlp = logprobs_from_logits(ref_logits[:, :-1], tokens[:, P:])
         values = out["values"].astype(jnp.float32)[:, P - 1 : -1]
         rmask = mask[:, P:]
         rewards, kl = kl_penalty_rewards(lp, rlp, rmask, scores, kl_coef)
@@ -600,8 +657,31 @@ class PPOTrainer(JaxBaseTrainer):
             snapshot = self._rollout_snapshot() if self.max_staleness > 0 else None
             self._rollout_producer.consume_done(snapshot=snapshot)
             self.store = self._rollout_producer.next_store()
-        self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size,
+            shuffle=True,
+            pack=self._pack_train_batch,
+            rows_multiple=self._pack_rows_multiple,
+        )
         self._log_phase_window()
+
+    def _prepare_batch(self, batch):
+        """Also meter the train phase's token throughput: count the tokens
+        the step will PROCESS (padded row area — the quantity the hardware
+        pays for) and, when packing, the batch's fill fraction. Appended
+        per-batch (list append: safe from the prefetch thread), reduced at
+        the next phase window."""
+        if isinstance(batch, PackedPPOBatch):
+            tokens = int(np.prod(batch.input_ids.shape))
+            if batch.extras and "pack_fill" in batch.extras:
+                self._window_fill.append(float(batch.extras["pack_fill"]))
+        else:
+            tokens = batch.query_tensors.shape[0] * (
+                batch.query_tensors.shape[1] + batch.response_tensors.shape[1]
+            )
+        # The same device batch feeds every PPO inner epoch.
+        self._window_tokens.append(tokens * max(1, getattr(self, "n_updates_per_batch", 1)))
+        return super()._prepare_batch(batch)
 
     def _log_phase_window(self):
         """Flush the phase timer at the rollout boundary: one window spans
@@ -609,6 +689,13 @@ class PPOTrainer(JaxBaseTrainer):
         overlaps — and feeds time/* + overlap_fraction to the tracker and
         the progress line."""
         stats = self._phase_timer.window()
+        window_tokens, self._window_tokens = self._window_tokens, []
+        window_fill, self._window_fill = self._window_fill, []
+        train_s = stats.get("time/train_s", 0.0)
+        if window_tokens and train_s > 0:
+            stats["train_tokens_per_s"] = float(sum(window_tokens)) / train_s
+        if window_fill:
+            stats["train_batch_fill"] = float(np.mean(window_fill))
         if self._last_exp_stats:
             stats.update(self._last_exp_stats)
         self._last_phase_stats = stats
@@ -617,7 +704,12 @@ class PPOTrainer(JaxBaseTrainer):
     def prepare_learning(self):
         """(reference: trlx/model/accelerate_ppo_model.py:167-184)"""
         self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
-        self.train_dataloader = self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        self.train_dataloader = self.store.create_loader(
+            self.config.train.batch_size,
+            shuffle=True,
+            pack=self._pack_train_batch,
+            rows_multiple=self._pack_rows_multiple,
+        )
         self.n_updates_per_batch = self.config.method.ppo_epochs
         self.total_steps = min(
             self.config.train.epochs * self.n_updates_per_batch * len(self.train_dataloader),
@@ -669,8 +761,17 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
     definition of the PPO update."""
     m = config.method
     P = prompt_length
+    use_fused = resolve_fused_head(model.cfg)
+    packed = bool(getattr(m, "pack_train_batch", False))
+    loss_kwargs = dict(
+        gamma=m.gamma,
+        lam=m.lam,
+        cliprange=m.cliprange,
+        cliprange_value=m.cliprange_value,
+        vf_coef=m.vf_coef,
+    )
 
-    def loss_fn(params, batch: PPORLBatch):
+    def dense_loss_fn(params, batch: PPORLBatch):
         params = detach_frozen(params)
         all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
         all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
@@ -679,18 +780,54 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
         lp = logprobs_from_logits(logits[:, :-1], all_ids[:, P:])
         vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
         return ppo_loss(
-            lp,
-            vpred,
-            batch.logprobs,
-            batch.values,
-            batch.rewards,
-            batch.response_mask,
-            gamma=m.gamma,
-            lam=m.lam,
-            cliprange=m.cliprange,
-            cliprange_value=m.cliprange_value,
-            vf_coef=m.vf_coef,
+            lp, vpred, batch.logprobs, batch.values, batch.rewards,
+            batch.response_mask, **loss_kwargs,
         )
+
+    def fused_loss_fn(params, batch: PPORLBatch):
+        # Same update, fused head: the policy's per-label logprobs come out
+        # of the streaming kernel (with its custom VJP), so no [b, R, V]
+        # fp32 logits buffer is live anywhere in the step — forward or
+        # backward.
+        params = detach_frozen(params)
+        all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
+        all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
+        out = model.apply(
+            {"params": params}, all_ids, all_mask, logits_start=P - 1,
+            labels=all_ids[:, P:], labels_mask=batch.response_mask,
+        )
+        vpred = out["values"].astype(jnp.float32)[:, P - 1 : -1]
+        return ppo_loss(
+            out["logprobs"], vpred, batch.logprobs, batch.values, batch.rewards,
+            batch.response_mask, **loss_kwargs,
+        )
+
+    def packed_loss_fn(params, batch: PackedPPOBatch):
+        # Packed layout: episodes live as segments inside dense rows
+        # (pipeline.ppo_pipeline.pack_ppo_batch). segment_ids drive the
+        # block-diagonal attention and the GAE reset; loss_mask marks the
+        # response state positions; per-sequence stats normalize by the
+        # TRUE episode count (== train batch_size, drop_last guarantees).
+        params = detach_frozen(params)
+        out = model.apply(
+            {"params": params}, batch.input_ids, batch.attention_mask,
+            position_ids=batch.position_ids, segment_ids=batch.segment_ids,
+            labels=batch.labels, labels_mask=batch.loss_mask,
+        )
+        vpred = out["values"].astype(jnp.float32)
+        return ppo_loss(
+            out["logprobs"], vpred, batch.old_logprobs, batch.old_values,
+            batch.rewards, batch.loss_mask,
+            segment_ids=batch.segment_ids, n_seqs=config.train.batch_size,
+            **loss_kwargs,
+        )
+
+    if packed:
+        loss_fn = packed_loss_fn
+    elif use_fused:
+        loss_fn = fused_loss_fn
+    else:
+        loss_fn = dense_loss_fn
 
     def train_step(state, batch: PPORLBatch):
         (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
