@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke obs-smoke acceptance-network
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -60,6 +60,12 @@ bench-reference:
 # a tiny bucketed rollout (trace count <= n_buckets). Writes BENCH_SMOKE.json.
 bench-smoke:
 	$(TEST_ENV) python bench_smoke.py
+
+# CPU observability smoke, ~1 min: a short overlapped PPO run with span
+# tracing, device telemetry, and the slow_step anomaly drill armed, then the
+# report renderer over the artifacts. Writes OBS_SMOKE.json + OBS_REPORT.md.
+obs-smoke:
+	$(TEST_ENV) python obs_smoke.py
 
 # Network-day acceptance: the four reference acceptance examples + gates in
 # one command, distilled to ACCEPTANCE.json (RUNBOOK.md). Offline it still

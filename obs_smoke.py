@@ -1,0 +1,157 @@
+"""CPU smoke of the observability layer: minutes, no TPU, CI-safe.
+
+One probe run — a short overlapped PPO randomwalks run (max_staleness=1)
+with every observability surface armed:
+
+- span tracing (train.trace_spans): spans.jsonl must hold valid Chrome
+  trace events with the producer / score-worker / main threads on distinct
+  lanes and producer/train wall-clock overlap actually visible;
+- device telemetry (train.device_telemetry, TRLX_TPU_PEAK_TFLOPS pinned so
+  CPU gets an MFU %): metrics.jsonl must carry obs/train_mfu_pct and the
+  kernel-routing gauges, and programs.json must register the train step;
+- anomaly capture (train.anomaly_factor + the TRLX_TPU_FAULTS=slow_step
+  drill): an incident bundle with thread stacks must land;
+- reporting: trlx_tpu.observability.report must render every section from
+  the run's artifacts and export the chrome://tracing JSON.
+
+Writes OBS_SMOKE.json + OBS_REPORT.md and prints one JSON summary line;
+exits 1 on any failure. Wall time ~1 min on a laptop CPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(REPO, "OBS_SMOKE.json")
+REPORT_OUT = os.path.join(REPO, "OBS_REPORT.md")
+
+
+def observability_probe():
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    os.environ["TRLX_TPU_FAULTS"] = "slow_step@6"
+    os.environ["TRLX_TPU_SLOW_STEP_SECONDS"] = "1.5"
+    os.environ["TRLX_TPU_PEAK_TFLOPS"] = "0.01"
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    import trlx_tpu
+    from randomwalks import base_config, generate_random_walks
+    from trlx_tpu.observability import report, spans
+
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.trace_spans = True
+    config.train.device_telemetry = True
+    config.train.anomaly_factor = 3.0
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    config.method.max_staleness = 1
+    d = tempfile.mkdtemp(prefix="obs_smoke_")
+    config.train.checkpoint_dir = d
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    t0 = time.time()
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    wall_s = time.time() - t0
+    assert model.iter_count >= 8
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("trlx-")]
+    assert not leaked, f"pipeline threads leaked: {leaked}"
+
+    # --- spans: distinct lanes, visible producer/train overlap ------------
+    events = spans.read_spans(os.path.join(d, spans.SPANS_FILENAME))
+    assert events and {e["ph"] for e in events} <= {"X", "i", "M"}, "bad trace events"
+    lanes = {e["args"]["name"]: e["tid"] for e in events if e["ph"] == "M"}
+    for thread in ("MainThread", "trlx-rollout-producer", "trlx-score-worker"):
+        assert thread in lanes, f"missing span lane: {thread} (have {sorted(lanes)})"
+    xs = [e for e in events if e["ph"] == "X"]
+    producer = [e for e in xs if e["name"] == "rollout/produce"]
+    train = [e for e in xs if e["name"] == "train/step"]
+    assert producer and train, "producer/train spans missing"
+
+    def overlap_us(a, b):
+        return min(a["ts"] + a["dur"], b["ts"] + b["dur"]) - max(a["ts"], b["ts"])
+
+    overlap_s = max(
+        (overlap_us(p, t) for p in producer for t in train), default=0
+    ) / 1e6
+    assert overlap_s > 0, "no producer/train overlap visible in spans"
+
+    # --- telemetry: MFU + routing gauges + program registry ---------------
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    mfu = [r["obs/train_mfu_pct"] for r in records if "obs/train_mfu_pct" in r]
+    assert mfu and all(m > 0 for m in mfu), f"MFU gauges missing/zero: {mfu}"
+    routed = [r for r in records if "obs/fused_logprob_active" in r]
+    assert routed, "kernel-routing gauges missing"
+    with open(os.path.join(d, "programs.json")) as f:
+        programs = json.load(f)
+    assert "train/step" in programs and programs["train/step"]["dispatches"] >= 8
+
+    # --- anomaly: the slow_step drill produced a bundle -------------------
+    incidents_dir = os.path.join(d, "incidents")
+    bundles = sorted(os.listdir(incidents_dir)) if os.path.isdir(incidents_dir) else []
+    assert bundles, "slow_step drill produced no incident bundle"
+    with open(os.path.join(incidents_dir, bundles[0], "incident.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "slow_step", manifest
+    assert manifest["sections"]["threads"] == "ok", manifest["sections"]
+    with open(os.path.join(incidents_dir, bundles[0], "threads.txt")) as f:
+        assert "trlx-" in f.read(), "pipeline threads absent from stack dump"
+
+    # --- report: renders every section + exports the trace ----------------
+    trace_out = os.path.join(d, "trace.json")
+    assert report.main([d, "-o", REPORT_OUT, "--trace-out", trace_out]) == 0
+    with open(REPORT_OUT) as f:
+        md = f.read()
+    for heading in ("## Span lanes", "## MFU / FLOP throughput", "## Incidents"):
+        assert heading in md, f"report section missing: {heading}"
+    assert "slow_step" in md
+
+    return {
+        "steps": model.iter_count,
+        "span_events": len(events),
+        "lanes": sorted(lanes),
+        "producer_train_overlap_s": round(overlap_s, 2),
+        "mfu_windows": len(mfu),
+        "mfu_last_pct": round(mfu[-1], 3),
+        "incident": f"incidents/{bundles[0]}",
+        "report_bytes": len(md),
+        "seconds": round(wall_s, 2),
+    }
+
+
+def main():
+    t0 = time.time()
+    result = {"observability": observability_probe()}
+    result["wall_s"] = round(time.time() - t0, 1)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"smoke": "ok", **result}))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — CI needs the one-line verdict
+        print(json.dumps({"smoke": "FAIL", "error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

@@ -1,0 +1,425 @@
+"""Observability layer (trlx_tpu/observability/): span tracing, device
+telemetry, anomaly-triggered incident capture, and the report renderer.
+
+Unit tier: SpanTracer lane/metadata semantics (including OS-ident reuse),
+torn-tail + concurrent-writer file contracts, AnomalyDetector baseline math,
+IncidentCapture bundle contents and budget, DeviceMonitor compiled-cost
+capture and the MFU arithmetic cross-check against bench.py's formula.
+
+Integration tier (CPU): the acceptance run — a short overlapped PPO run at
+max_staleness=1 with spans + telemetry + anomaly armed and the
+``slow_step`` fault drill produces a Perfetto-loadable spans.jsonl with the
+producer/score/train threads on distinct lanes and visible overlap, MFU
+gauges in metrics.jsonl, an incident bundle with thread stacks, and a
+report that renders every section.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.observability import anomaly as obs_anomaly  # noqa: E402
+from trlx_tpu.observability import devicemon, report  # noqa: E402
+from trlx_tpu.observability import spans as obs_spans  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _span_isolation():
+    """The tracer is a process global armed by trainers/tests — always disarm
+    so one test's spans.jsonl (in a deleted tmp_path) never leaks forward."""
+    yield
+    obs_spans.shutdown()
+    obs_anomaly.register_emergency(None)
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_trace_span_disabled_is_shared_noop():
+    obs_spans.shutdown()
+    assert not obs_spans.enabled()
+    a = obs_spans.trace_span("x", step=1)
+    b = obs_spans.trace_span("y")
+    assert a is b  # shared singleton: no per-call allocation on the off path
+    with a:
+        pass
+    obs_spans.complete("z", time.time())  # no-ops, no file appears
+    obs_spans.instant("w")
+
+
+def test_span_lanes_survive_os_thread_ident_reuse(tmp_path):
+    """Sequential threads commonly REUSE the OS thread ident; lanes are keyed
+    by synthetic per-thread-object tids so each thread still gets its own
+    lane + thread_name metadata (the bug this guards: a rollout producer
+    inheriting a dead prefetch thread's ident and lane label)."""
+    path = str(tmp_path / "spans.jsonl")
+    obs_spans.configure(path)
+    with obs_spans.trace_span("main/work", step=1):
+        pass
+
+    def worker():
+        with obs_spans.trace_span("bg/work"):
+            time.sleep(0.01)
+
+    for name in ("lane-a", "lane-b"):  # b starts only after a exits
+        t = threading.Thread(target=worker, name=name)
+        t.start()
+        t.join()
+    obs_spans.instant("tick", step=2)
+    obs_spans.shutdown()
+
+    events = obs_spans.read_spans(path)
+    meta = {e["tid"]: e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert len(meta) == 3  # three threads -> three lanes, no merging
+    assert {"MainThread", "lane-a", "lane-b"} <= set(meta.values())
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len({e["tid"] for e in xs if e["name"] == "bg/work"}) == 2
+    main_span = next(e for e in xs if e["name"] == "main/work")
+    assert main_span["args"] == {"step": 1}
+    assert meta[main_span["tid"]] == "MainThread"
+    instant = next(e for e in events if e["ph"] == "i")
+    assert instant["s"] == "t" and instant["tid"] == main_span["tid"]
+
+
+def test_span_exit_on_exception_annotates_error(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    obs_spans.configure(path)
+    with pytest.raises(ValueError):
+        with obs_spans.trace_span("rollout/decode", step=3):
+            raise ValueError("boom")
+    obs_spans.shutdown()
+    span = next(e for e in obs_spans.read_spans(path) if e["ph"] == "X")
+    assert span["args"] == {"step": 3, "error": "ValueError"}
+
+
+def test_span_file_torn_tail_tolerated_like_metrics(tmp_path):
+    """Both JSONL writers (Tracker's metrics.jsonl, SpanTracer's spans.jsonl)
+    share one reader contract: a writer killed mid-append tears at most the
+    final line, which readers drop with a warning; mid-file garbage raises."""
+    path = str(tmp_path / "spans.jsonl")
+    obs_spans.configure(path)
+    for i in range(3):
+        obs_spans.complete("train/step", time.time() - 0.01, step=i)
+    obs_spans.shutdown()
+    with open(path, "ab") as f:
+        f.write(b'{"name": "train/step", "ph": "X", "ts": 12')  # torn mid-record
+    with pytest.warns(UserWarning, match="torn final record"):
+        events = obs_spans.read_spans(path)
+    assert sum(e["ph"] == "X" for e in events) == 3
+
+    # the SAME torn file mid-stream is corruption, not a tear
+    with open(path, "ab") as f:
+        f.write(b'\n{"name": "later", "ph": "i", "ts": 13}\n')
+    with pytest.raises(json.JSONDecodeError):
+        obs_spans.read_spans(path)
+
+
+def test_concurrent_span_writers_never_interleave(tmp_path):
+    """Line-atomicity under contention: many threads hammering one tracer
+    (unbuffered O_APPEND, one write(2) per record) must yield a file where
+    EVERY line parses — no interleaved or split records."""
+    path = str(tmp_path / "spans.jsonl")
+    obs_spans.configure(path)
+    n_threads, n_spans = 8, 200
+
+    def hammer(k):
+        for i in range(n_spans):
+            obs_spans.complete("stress/span", time.time(), writer=k, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(k,), name=f"stress-{k}") for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    obs_spans.shutdown()
+
+    with open(path, "rb") as f:
+        lines = [ln for ln in f.read().split(b"\n") if ln.strip()]
+    events = [json.loads(ln) for ln in lines]  # raises if any line tore
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == n_threads * n_spans
+    assert len({e["tid"] for e in xs}) == n_threads
+
+
+def test_span_writer_disarms_on_io_error_instead_of_raising(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    obs_spans.configure(path)
+    # simulate the disk going away mid-run: close the fd under the tracer
+    obs_spans._STATE["tracer"]._file.close()
+    with pytest.warns(UserWarning, match="span tracing disabled"):
+        obs_spans.instant("after_close")
+    assert not obs_spans.enabled()
+    obs_spans.instant("noop")  # disarmed: silent no-op, run continues
+
+
+# ------------------------------------------------------------------ anomaly
+
+
+def test_anomaly_detector_baseline_seed_and_breach():
+    det = obs_anomaly.AnomalyDetector(factor=3.0, window=16, min_samples=5)
+    # seeding: nothing may trip before min_samples observations, even spikes
+    for _ in range(4):
+        assert not det.observe(1.0)
+    assert not det.observe(50.0)  # 5th observation still seeds
+    assert det.p50() == 1.0
+    # breach: > factor * p50 trips, and is NOT absorbed into the baseline
+    assert det.observe(4.0)
+    assert det.p50() == 1.0
+    assert not det.observe(2.9)  # under 3x median: normal
+
+
+def test_anomaly_detector_factor_zero_disables():
+    det = obs_anomaly.AnomalyDetector(factor=0.0)
+    assert not any(det.observe(x) for x in [0.1] * 10 + [1000.0])
+
+
+def test_incident_capture_bundle_contents_and_budget(tmp_path):
+    metrics = tmp_path / "metrics.jsonl"
+    metrics.write_text('{"loss": 1.0, "step": 1}\n{"loss": 0.5, "step": 2}\n')
+    cap = obs_anomaly.IncidentCapture(
+        str(tmp_path), metrics_path=str(metrics), max_incidents=2, last_n_metrics=1
+    )
+    bundle = cap.capture(7, "unit_drill", detail={"step_time": 9.9})
+    assert bundle.endswith(os.path.join("incidents", "7"))
+
+    with open(os.path.join(bundle, "incident.json")) as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 7 and manifest["reason"] == "unit_drill"
+    assert manifest["detail"] == {"step_time": 9.9}
+    assert manifest["sections"]["threads"] == "ok"
+    assert manifest["sections"]["memory"] == "ok"
+    with open(os.path.join(bundle, "threads.txt")) as f:
+        assert "MainThread" in f.read()
+    with open(os.path.join(bundle, "memory.json")) as f:
+        assert "gauges" in json.load(f)
+    with open(os.path.join(bundle, "last_metrics.json")) as f:
+        assert json.load(f) == [{"loss": 0.5, "step": 2}]  # tail only
+
+    assert cap.capture(8, "unit_drill")
+    assert cap.capture(9, "unit_drill") == ""  # budget spent: rate-limited
+
+
+def test_emergency_capture_hook_roundtrip(tmp_path):
+    cap = obs_anomaly.IncidentCapture(str(tmp_path), max_incidents=1)
+    obs_anomaly.emergency_capture("collective_timeout")  # nothing registered: no-op
+    obs_anomaly.register_emergency(cap, step_provider=lambda: 42)
+    obs_anomaly.emergency_capture("collective_timeout", detail={"op": "psum"})
+    with open(os.path.join(str(tmp_path), "incidents", "42", "incident.json")) as f:
+        assert json.load(f)["reason"] == "collective_timeout"
+
+
+# ---------------------------------------------------------------- devicemon
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("TRLX_TPU_PEAK_TFLOPS", "0.5")
+    assert devicemon.detect_peak_flops() == pytest.approx(0.5e12)
+
+
+def test_device_monitor_capture_dispatch_accounting_and_mfu():
+    """The acceptance arithmetic: the MFU gauge must match bench.py's formula
+    (100 * flops / seconds / peak) computed by hand from the SAME captured
+    cost_analysis FLOPs, to 2%."""
+    import jax
+    import jax.numpy as jnp
+
+    peak = 1e9  # pinned synthetic peak: CPU has no table entry
+    mon = devicemon.DeviceMonitor(peak_flops=peak)
+    step = mon.wrap("train/step", jax.jit(lambda x: x @ x), phase="train")
+    x = jnp.ones((64, 64), jnp.float32)
+    for _ in range(3):
+        step(x).block_until_ready()
+
+    prog = mon.snapshot()["train/step"]
+    assert prog["phase"] == "train" and prog["dispatches"] == 3
+    assert len(prog["variants"]) == 1  # one signature -> ONE capture
+    flops = prog["variants"][0]["flops"]
+    assert flops > 0
+
+    train_s = 2.0
+    stats = mon.window({"train": train_s, "wall": train_s})
+    expected_mfu = 100.0 * (3 * flops) / train_s / peak  # bench.py arithmetic
+    assert stats["obs/train_mfu_pct"] == pytest.approx(expected_mfu, rel=0.02)
+    assert stats["obs/iter_mfu_pct"] == pytest.approx(expected_mfu, rel=0.02)
+    assert stats["obs/train_tflops_per_chip"] == pytest.approx(3 * flops / train_s / 1e12, rel=0.02)
+
+    assert mon.window({"train": 1.0, "wall": 1.0}) == {}  # counters drained
+
+    step(jnp.ones((32, 32), jnp.float32)).block_until_ready()  # new shape
+    assert len(mon.snapshot()["train/step"]["variants"]) == 2
+
+
+def test_monitored_fn_delegates_attributes_and_survives_capture_failure():
+    mon = devicemon.DeviceMonitor(peak_flops=None)
+
+    def fn(x):
+        return x + 1
+
+    fn.num_traces = 7  # the closure counters make_generate_fn exposes
+    wrapped = mon.wrap("rollout/generate", fn, phase="rollout")
+    assert wrapped.num_traces == 7
+    assert wrapped(1) == 2  # plain fn: .lower() fails, call still goes through
+    variant = mon.snapshot()["rollout/generate"]["variants"][0]
+    assert variant["flops"] == 0.0 and "error" in variant
+
+
+def test_routing_and_memory_gauges_have_stable_keys():
+    routing = devicemon.kernel_routing_gauges()
+    assert set(routing) == {
+        "obs/decode_attn_active",
+        "obs/decode_attn_fallback",
+        "obs/fused_logprob_active",
+        "obs/fused_logprob_fallback",
+    }
+    assert all(v in (0.0, 1.0) for v in routing.values())
+    memory = devicemon.device_memory_gauges()
+    assert memory  # CPU backend: live_array census fallback
+    assert all(k.startswith("obs/") and v >= 0 for k, v in memory.items())
+
+
+def test_rollup_is_identity_valued_on_single_process():
+    """hostmean/hostmax of a one-host gather are the host's own values (pods
+    exercise the real allgather; the keys are identical either way)."""
+    stats = {"obs/train_mfu_pct": 12.5, "time/train_s": 3.0, "skip_me": "str"}
+    assert report.rollup_window_stats(stats) == {
+        "obs/train_mfu_pct/hostmean": 12.5,
+        "obs/train_mfu_pct/hostmax": 12.5,
+        "time/train_s/hostmean": 3.0,
+        "time/train_s/hostmax": 3.0,
+    }
+    assert report.rollup_window_stats({}) == {}
+
+
+# ------------------------------------------------------------ e2e acceptance
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def test_e2e_overlapped_run_spans_telemetry_incident_report(task, tmp_path, monkeypatch):
+    """The PR's acceptance run: overlapped PPO (max_staleness=1) with every
+    observability surface armed and the slow_step drill injected."""
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "slow_step@6")
+    monkeypatch.setenv("TRLX_TPU_SLOW_STEP_SECONDS", "1.5")
+    monkeypatch.setenv("TRLX_TPU_PEAK_TFLOPS", "0.01")  # only way to get MFU on CPU
+
+    _, logit_mask, metric_fn, reward_fn = task
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.trace_spans = True
+    config.train.device_telemetry = True
+    config.train.anomaly_factor = 3.0
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    config.method.max_staleness = 1
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+
+    # --- spans.jsonl: valid Chrome trace events on distinct thread lanes ---
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no torn tail on a clean shutdown
+        events = obs_spans.read_spans(os.path.join(str(tmp_path), "spans.jsonl"))
+    assert events and {e["ph"] for e in events} <= {"X", "i", "M"}
+    lanes = {e["args"]["name"]: e["tid"] for e in events if e["ph"] == "M"}
+    assert "MainThread" in lanes
+    assert "trlx-rollout-producer" in lanes
+    assert "trlx-score-worker" in lanes
+    assert len(set(lanes.values())) == len(lanes)  # one lane per thread
+
+    xs = [e for e in events if e["ph"] == "X"]
+    names = {e["name"] for e in xs}
+    assert {
+        "train/step", "rollout/produce", "rollout/generate", "rollout/decode",
+        "rollout/reward_fn", "score/host", "ckpt/save",
+    } <= names
+
+    producer = [e for e in xs if e["name"] == "rollout/produce"]
+    train = [e for e in xs if e["name"] == "train/step"]
+    assert {e["tid"] for e in producer} == {lanes["trlx-rollout-producer"]}
+    assert {e["tid"] for e in train} == {lanes["MainThread"]}
+    # a fresh score worker spawns per experience window — every score/host
+    # span must sit on SOME trlx-score-worker lane (and never the main lane)
+    lane_names = {e["tid"]: e["args"]["name"] for e in events if e["ph"] == "M"}
+    score_lanes = {lane_names[e["tid"]] for e in xs if e["name"] == "score/host"}
+    assert score_lanes == {"trlx-score-worker"}
+
+    def overlap_us(a, b):
+        return min(a["ts"] + a["dur"], b["ts"] + b["dur"]) - max(a["ts"], b["ts"])
+
+    # staleness=1: the producer builds store N+1 WHILE the trainer steps on N
+    assert any(overlap_us(p, t) > 0 for p in producer for t in train)
+
+    # --- metrics.jsonl: compiled-cost MFU + kernel-routing gauges ---------
+    with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    mfu = [r["obs/train_mfu_pct"] for r in records if "obs/train_mfu_pct" in r]
+    assert mfu and all(m > 0 for m in mfu)
+    routed = [r for r in records if "obs/fused_logprob_active" in r]
+    assert routed
+    for key in ("obs/decode_attn_active", "obs/decode_attn_fallback", "obs/fused_logprob_fallback"):
+        assert key in routed[-1]
+    stale = [r["staleness/mean"] for r in records if "staleness/mean" in r]
+    assert stale and stale[-1] == 1.0  # the pipeline genuinely ran ahead
+
+    # programs.json: registry for the report's program table
+    with open(os.path.join(str(tmp_path), "programs.json")) as f:
+        programs = json.load(f)
+    assert "train/step" in programs
+    assert programs["train/step"]["dispatches"] >= 8
+
+    # --- incident bundle from the slow_step drill -------------------------
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    bundles = os.listdir(incidents_dir)
+    assert bundles, "slow_step drill produced no incident bundle"
+    with open(os.path.join(incidents_dir, bundles[0], "incident.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "slow_step"
+    assert manifest["detail"]["step_time"] > 1.0  # the injected stall
+    assert manifest["sections"]["threads"] == "ok"
+    with open(os.path.join(incidents_dir, bundles[0], "threads.txt")) as f:
+        assert "trlx-" in f.read()  # the pipeline threads ARE in the dump
+
+    # --- report renders every section ------------------------------------
+    md = report.build_report(str(tmp_path))
+    for heading in (
+        "# Performance report",
+        "## Phase breakdown (per window)",
+        "## MFU / FLOP throughput",
+        "## Kernel routing",
+        "### Monitored programs",
+        "## Span lanes",
+        "## Incidents",
+    ):
+        assert heading in md
+    assert "slow_step" in md
+    assert "trlx-rollout-producer" in md
+
+    out_md = tmp_path / "report.md"
+    trace_out = tmp_path / "trace.json"
+    assert report.main([str(tmp_path), "-o", str(out_md), "--trace-out", str(trace_out)]) == 0
+    assert "slow_step" in out_md.read_text()
+    assert json.loads(trace_out.read_text())["traceEvents"]

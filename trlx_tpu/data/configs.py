@@ -185,6 +185,31 @@ class TrainConfig:
     # cost of one tiny allgather per N steps.
     preempt_check_interval: int = 0
 
+    # --- observability (trlx_tpu/observability/) ---
+    # Cross-thread span tracing: host-side spans from the train loop, the
+    # pipeline threads, checkpointing, and the collective guards land as
+    # Chrome trace events in <checkpoint_dir>/spans.jsonl (one lane per
+    # thread per host; open in Perfetto). TRLX_TPU_SPANS=1 overrides to on.
+    trace_spans: bool = False
+    # Compiled-cost telemetry: capture cost_analysis()/memory_analysis() at
+    # each monitored program's first dispatch and derive per-window
+    # obs/train_mfu_pct + kernel-routing/device-memory gauges in
+    # metrics.jsonl. One synchronous AOT compile per program at first
+    # dispatch (absorbed by compile_cache_dir when set).
+    # TRLX_TPU_DEVICE_TELEMETRY=1 overrides to on.
+    device_telemetry: bool = False
+    # Anomaly capture: a step slower than anomaly_factor × rolling-p50 step
+    # time (or a watchdog/guard event) writes a one-shot incident bundle —
+    # thread stacks, device-memory snapshot, metrics tail, profiler trace —
+    # under <checkpoint_dir>/incidents/<step>/. 0 disables the step-time
+    # detector (resilience-event capture still requires a factor > 0 to arm
+    # the capture machinery). TRLX_TPU_ANOMALY_FACTOR overrides.
+    anomaly_factor: float = 0.0
+    # Trailing window (observations) for the detector's rolling p50, and the
+    # per-run cap on captured incident bundles.
+    anomaly_window: int = 64
+    max_incidents: int = 4
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         cfg = dict(config)

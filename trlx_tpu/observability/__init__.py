@@ -1,0 +1,32 @@
+"""Unified observability layer (PR 8).
+
+Four parts, all off-hot-path and off by default:
+
+- ``spans``     — cross-thread Chrome-trace span tracing into
+                  ``<ckpt_dir>/spans.jsonl`` (``train.trace_spans`` /
+                  ``TRLX_TPU_SPANS=1``);
+- ``devicemon`` — compiled-cost capture (``cost_analysis`` /
+                  ``memory_analysis``) for every jitted program, real-FLOPs
+                  MFU gauges, kernel-routing + device-memory gauges
+                  (``train.device_telemetry`` / ``TRLX_TPU_DEVICE_TELEMETRY=1``);
+- ``anomaly``   — rolling-median step-time detector + one-shot incident
+                  bundles under ``<ckpt_dir>/incidents/<step>/``
+                  (``train.anomaly_factor`` / ``TRLX_TPU_ANOMALY_FACTOR``);
+- ``report``    — ``python -m trlx_tpu.observability.report <ckpt_dir>``
+                  renders everything as one markdown performance report.
+
+See RUNBOOK.md §8 for knobs and triage.
+"""
+
+import os
+
+from trlx_tpu.observability import spans  # noqa: F401 — canonical import point
+from trlx_tpu.observability.anomaly import AnomalyDetector, IncidentCapture  # noqa: F401
+from trlx_tpu.observability.devicemon import DeviceMonitor  # noqa: F401
+from trlx_tpu.observability.spans import instant, trace_span  # noqa: F401
+
+
+def env_flag(name: str) -> bool:
+    """True when the env var is set to anything but '' / '0' (the same
+    convention as TRLX_TPU_DISABLE_TRACKER)."""
+    return os.environ.get(name, "") not in ("", "0")

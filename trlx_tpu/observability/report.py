@@ -1,0 +1,364 @@
+"""Performance report generator: metrics.jsonl + spans.jsonl -> markdown.
+
+    python -m trlx_tpu.observability.report <checkpoint_dir> [-o report.md]
+                                            [--trace-out trace.json]
+
+Merges everything the observability layer wrote during a run into one
+readable document: per-window phase breakdown, MFU trend from compiled-cost
+FLOPs, staleness distribution, kernel-routing table, span-lane accounting
+(with the measured producer/train overlap), and the incident index.
+``--trace-out`` additionally emits a ``{"traceEvents": [...]}`` wrapper of
+spans.jsonl for chrome://tracing (Perfetto loads the raw JSONL directly).
+
+Multi-host: each host appends to the SAME spans.jsonl (line-atomic, lanes
+keyed by pid) and rank 0 writes metrics.jsonl, so the report needs no
+gather at read time. For LIVE multi-host window stats,
+``rollup_window_stats`` aggregates each host's scalar window over the
+existing ``allgather_host`` path — the trainer calls it at the window
+boundary so metrics.jsonl carries fleet-mean/max gauges, not just rank 0's.
+"""
+
+import argparse
+import json
+import os
+import warnings
+from collections import defaultdict
+
+import numpy as np
+
+__all__ = ["build_report", "rollup_window_stats", "main"]
+
+
+# ------------------------------------------------------------------ rollup
+
+
+def rollup_window_stats(stats: dict) -> dict:
+    """Aggregate one window's scalar stats across hosts.
+
+    Returns ``{key/hostmean, key/hostmax}`` for every float-valued key, via
+    ``allgather_host`` — so it MUST be called collectively (every host, same
+    window boundary). Identity-shaped at process_count()==1: the mean/max of
+    one host is itself (tests exercise this path; pods get the real gather)."""
+    import jax
+
+    keys = sorted(k for k, v in stats.items() if isinstance(v, (int, float)))
+    if not keys:
+        return {}
+    row = np.asarray([float(stats[k]) for k in keys], dtype=np.float64)
+    if jax.process_count() == 1:
+        gathered = row[None, :]
+    else:
+        from trlx_tpu.parallel.mesh import allgather_host
+
+        gathered = np.asarray(allgather_host(row[None, :])).reshape(-1, len(keys))
+    out = {}
+    for j, key in enumerate(keys):
+        out[f"{key}/hostmean"] = float(gathered[:, j].mean())
+        out[f"{key}/hostmax"] = float(gathered[:, j].max())
+    return out
+
+
+# ----------------------------------------------------------------- loading
+
+
+def _load_jsonl(path):
+    from trlx_tpu.utils.logging import read_jsonl
+
+    if not os.path.exists(path):
+        return []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # torn tails are routine post-kill
+        return read_jsonl(path)
+
+
+def _scalar_records(metrics):
+    return [r for r in metrics if "step" in r and "table" not in r and "histogram" not in r]
+
+
+def _fmt(value, digits=3):
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.{digits}f}"
+    return str(value)
+
+
+def _trend(values, width: int = 24) -> str:
+    """Coarse text sparkline — enough to see an MFU ramp or collapse."""
+    if not values:
+        return ""
+    marks = " .:-=+*#"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    if len(values) > width:
+        # Mean-pool to `width` buckets.
+        idx = np.array_split(np.asarray(values, dtype=np.float64), width)
+        values = [float(chunk.mean()) for chunk in idx if chunk.size]
+    return "".join(marks[int((v - lo) / span * (len(marks) - 1))] for v in values)
+
+
+# ----------------------------------------------------------------- spans
+
+
+def _lane_summary(spans):
+    """Per-(pid, tid) lane accounting + cross-lane overlap of X-events."""
+    names = {}
+    lanes = defaultdict(lambda: {"events": 0, "busy_us": 0, "top": defaultdict(int)})
+    for event in spans:
+        key = (event.get("pid", 0), event.get("tid", 0))
+        if event.get("ph") == "M" and event.get("name") == "thread_name":
+            names[key] = event.get("args", {}).get("name", "?")
+        elif event.get("ph") == "X":
+            lane = lanes[key]
+            lane["events"] += 1
+            lane["busy_us"] += int(event.get("dur", 0))
+            lane["top"][event.get("name", "?")] += int(event.get("dur", 0))
+    rows = []
+    for key, lane in sorted(lanes.items()):
+        top = max(lane["top"].items(), key=lambda kv: kv[1])[0] if lane["top"] else "-"
+        rows.append(
+            {
+                "pid": key[0],
+                "tid": key[1],
+                "thread": names.get(key, "?"),
+                "events": lane["events"],
+                "busy_s": lane["busy_us"] / 1e6,
+                "top_span": top,
+            }
+        )
+    return rows
+
+
+def _overlap_seconds(spans, lane_a_substr: str, lane_b_substr: str):
+    """Wall seconds where an X-span on a thread named like A overlaps one on
+    a thread named like B — the picture-level form of overlap_fraction."""
+    names = {}
+    for event in spans:
+        if event.get("ph") == "M" and event.get("name") == "thread_name":
+            names[(event.get("pid", 0), event.get("tid", 0))] = event.get("args", {}).get("name", "")
+
+    def intervals(substr):
+        out = []
+        for event in spans:
+            if event.get("ph") != "X":
+                continue
+            lane = names.get((event.get("pid", 0), event.get("tid", 0)), "")
+            if substr in lane:
+                t0 = event.get("ts", 0)
+                out.append((t0, t0 + event.get("dur", 0)))
+        out.sort()
+        return out
+
+    a, b = intervals(lane_a_substr), intervals(lane_b_substr)
+    total, i, j = 0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total / 1e6
+
+
+# ----------------------------------------------------------------- report
+
+
+def build_report(checkpoint_dir: str) -> str:
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    metrics = _load_jsonl(os.path.join(checkpoint_dir, "metrics.jsonl"))
+    spans = _load_jsonl(os.path.join(checkpoint_dir, "spans.jsonl"))
+    scalars = _scalar_records(metrics)
+    lines = [f"# Performance report — `{checkpoint_dir}`", ""]
+
+    # --- run summary ------------------------------------------------------
+    steps = [r["step"] for r in scalars if isinstance(r.get("step"), (int, float))]
+    hosts = sorted({e.get("pid", 0) for e in spans}) if spans else []
+    lines += ["## Run summary", ""]
+    lines.append(f"- scalar records: {len(scalars)}" + (f" (steps {int(min(steps))}..{int(max(steps))})" if steps else ""))
+    lines.append(f"- span events: {len(spans)}" + (f" across host pid(s) {hosts}" if hosts else ""))
+    times = [r["t"] for r in scalars if isinstance(r.get("t"), (int, float))]
+    if len(times) >= 2:
+        lines.append(f"- metrics wall span: {times[-1] - times[0]:.1f}s")
+    lines.append("")
+
+    # --- phase breakdown per window --------------------------------------
+    windows = [r for r in scalars if "time/window_wall_s" in r]
+    lines += ["## Phase breakdown (per window)", ""]
+    if windows:
+        lines.append("| step | rollout_s | score_s | train_s | wall_s | overlap | tokens/s |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for r in windows[-12:]:
+            lines.append(
+                "| {} | {} | {} | {} | {} | {} | {} |".format(
+                    _fmt(r.get("step"), 0),
+                    _fmt(r.get("time/rollout_s")),
+                    _fmt(r.get("time/score_s")),
+                    _fmt(r.get("time/train_s")),
+                    _fmt(r.get("time/window_wall_s")),
+                    _fmt(r.get("time/overlap_fraction"), 2),
+                    _fmt(r.get("train_tokens_per_s"), 0),
+                )
+            )
+        if len(windows) > 12:
+            lines.append(f"\n(last 12 of {len(windows)} windows)")
+    else:
+        lines.append("No phase windows recorded (serial single-batch run, or PhaseTimer off).")
+    lines.append("")
+
+    # --- MFU trend --------------------------------------------------------
+    lines += ["## MFU / FLOP throughput (compiled-cost derived)", ""]
+    mfu = [(r.get("step"), r["obs/train_mfu_pct"]) for r in scalars if "obs/train_mfu_pct" in r]
+    tfl = [r["obs/train_tflops_per_chip"] for r in scalars if "obs/train_tflops_per_chip" in r]
+    if mfu:
+        values = [v for _, v in mfu]
+        lines.append(
+            f"- train MFU: last {_fmt(values[-1], 2)}% · mean {_fmt(float(np.mean(values)), 2)}% "
+            f"· max {_fmt(max(values), 2)}% over {len(values)} windows"
+        )
+        lines.append(f"- trend: `{_trend(values)}`")
+    elif tfl:
+        lines.append(
+            f"- train TFLOP/s per chip: last {_fmt(tfl[-1], 2)} · mean {_fmt(float(np.mean(tfl)), 2)} "
+            "(peak FLOP/s unknown — set TRLX_TPU_PEAK_TFLOPS for an MFU %)"
+        )
+    else:
+        lines.append("No compiled-cost gauges recorded (train.device_telemetry off).")
+    lines.append("")
+
+    # --- staleness --------------------------------------------------------
+    lines += ["## Staleness", ""]
+    stale = [r for r in scalars if "staleness/mean" in r]
+    hists = [r for r in metrics if r.get("histogram") == "staleness"]
+    if stale:
+        means = [r["staleness/mean"] for r in stale]
+        maxes = [r.get("staleness/max", 0.0) for r in stale]
+        lines.append(
+            f"- per-batch staleness: mean {_fmt(float(np.mean(means)), 3)} · "
+            f"max {_fmt(float(np.max(maxes)), 1)} over {len(stale)} batches"
+        )
+    if hists:
+        last = hists[-1]
+        lines.append(
+            "- last histogram: " + " · ".join(
+                f"{k} {_fmt(last.get(k))}" for k in ("p5", "p50", "p95", "max") if k in last
+            )
+        )
+    if not stale and not hists:
+        lines.append("No staleness records (serial on-policy run).")
+    lines.append("")
+
+    # --- kernel routing ---------------------------------------------------
+    lines += ["## Kernel routing", ""]
+    routed = [r for r in scalars if "obs/fused_logprob_active" in r]
+    if routed:
+        last = routed[-1]
+        lines.append("| gauge | value |")
+        lines.append("|---|---|")
+        for key in sorted(k for k in last if k.startswith("obs/") and ("active" in k or "fallback" in k)):
+            lines.append(f"| {key} | {_fmt(last[key], 0)} |")
+        fallbacks = [k for k in last if k.endswith("_fallback") and last[k]]
+        if fallbacks:
+            lines.append("")
+            lines.append(f"**WARNING: silent kernel fallback active: {fallbacks}** — see RUNBOOK.md §8.")
+    else:
+        lines.append("No routing gauges recorded.")
+    programs_path = os.path.join(checkpoint_dir, "programs.json")
+    if os.path.exists(programs_path):
+        try:
+            with open(programs_path) as f:
+                programs = json.load(f)
+        except (OSError, ValueError):
+            programs = {}
+        if programs:
+            lines += ["", "### Monitored programs", "", "| program | phase | dispatches | GFLOPs | temp MiB |", "|---|---|---|---|---|"]
+            for name, prog in sorted(programs.items()):
+                variants = prog.get("variants", [])
+                flops = max((v.get("flops") or 0.0 for v in variants), default=0.0)
+                temp = max((v.get("temp_size_in_bytes") or 0 for v in variants), default=0)
+                lines.append(
+                    f"| {name} | {prog.get('phase')} | {prog.get('dispatches')} "
+                    f"| {_fmt(flops / 1e9, 2)} | {_fmt(temp / 2**20, 1)} |"
+                )
+    lines.append("")
+
+    # --- span lanes -------------------------------------------------------
+    lines += ["## Span lanes", ""]
+    if spans:
+        lanes = _lane_summary(spans)
+        lines.append("| pid | thread | events | busy_s | top span |")
+        lines.append("|---|---|---|---|---|")
+        for lane in lanes:
+            lines.append(
+                f"| {lane['pid']} | {lane['thread']} | {lane['events']} "
+                f"| {_fmt(lane['busy_s'], 2)} | {lane['top_span']} |"
+            )
+        overlap = _overlap_seconds(spans, "trlx-rollout-producer", "MainThread")
+        if overlap > 0:
+            lines.append("")
+            lines.append(f"- producer/train overlap: {_fmt(overlap, 2)}s of wall where both lanes were busy")
+        lines.append("")
+        lines.append("Load the raw lanes in Perfetto (https://ui.perfetto.dev): open `spans.jsonl` directly,")
+        lines.append("or `--trace-out trace.json` for chrome://tracing.")
+    else:
+        lines.append("No spans recorded (train.trace_spans off — set it or TRLX_TPU_SPANS=1).")
+    lines.append("")
+
+    # --- incidents --------------------------------------------------------
+    lines += ["## Incidents", ""]
+    incidents_dir = os.path.join(checkpoint_dir, "incidents")
+    bundles = sorted(os.listdir(incidents_dir)) if os.path.isdir(incidents_dir) else []
+    if bundles:
+        lines.append("| step | reason | sections | bundle |")
+        lines.append("|---|---|---|---|")
+        for name in bundles:
+            manifest_path = os.path.join(incidents_dir, name, "incident.json")
+            reason, sections = "?", "?"
+            try:
+                with open(manifest_path) as f:
+                    manifest = json.load(f)
+                reason = manifest.get("reason", "?")
+                sections = ",".join(k for k, v in manifest.get("sections", {}).items() if v == "ok")
+            except (OSError, ValueError):
+                pass
+            lines.append(f"| {name} | {reason} | {sections} | `incidents/{name}/` |")
+    else:
+        lines.append("None.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.observability.report",
+        description="Render a markdown performance report from a run's checkpoint dir.",
+    )
+    parser.add_argument("checkpoint_dir", help="directory holding metrics.jsonl / spans.jsonl")
+    parser.add_argument("-o", "--out", default=None, help="write the report here (default: stdout)")
+    parser.add_argument(
+        "--trace-out",
+        default=None,
+        help="also write spans.jsonl as a {'traceEvents': [...]} JSON for chrome://tracing",
+    )
+    args = parser.parse_args(argv)
+
+    report = build_report(args.checkpoint_dir)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}")
+    else:
+        print(report)
+
+    if args.trace_out:
+        spans = _load_jsonl(os.path.join(os.path.abspath(args.checkpoint_dir), "spans.jsonl"))
+        with open(args.trace_out, "w") as f:
+            json.dump({"traceEvents": spans}, f)
+        print(f"wrote {args.trace_out} ({len(spans)} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

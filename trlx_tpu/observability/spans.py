@@ -1,0 +1,240 @@
+"""Cross-thread span tracing: Chrome-trace-event JSONL with thread lanes.
+
+The overlapped pipeline (pipeline/overlap.py) runs four concurrent actors —
+the main train loop, ``trlx-rollout-producer``, ``trlx-score-worker``, and
+``trlx-prefetch`` — but metrics.jsonl only records per-window scalar sums, so
+"the overlap fraction was 0.4" is the MOST detailed statement the framework
+can make about where a window's wall clock went. This tracer turns that into
+a picture: host-side code wraps its phases in ``with trace_span(name):`` and
+each span lands as one Chrome trace event (``ph:"X"``) in
+``<checkpoint_dir>/spans.jsonl``, with ``pid`` = the JAX process index and
+``tid`` = a synthetic per-thread lane id, so Perfetto (https://ui.perfetto.dev
+— it opens JSONL event streams directly) renders one lane per thread per host
+and the producer/train overlap is visible as literally-overlapping boxes.
+
+Design constraints, in order:
+
+- **Off by default, zero residue.** ``trace_span`` returns a shared no-op
+  context manager until ``configure(path=...)`` arms the module global — no
+  allocation, no clock read, no branch beyond one dict load. The serial
+  path with spans disabled is byte-identical to pre-instrumentation runs.
+- **Crash-tolerant like metrics.jsonl.** The file is opened unbuffered in
+  O_APPEND mode and every event is ONE complete newline-terminated
+  ``write(2)`` — a process killed mid-run (preemption, ``host_kill`` drill)
+  can tear at most the final line, which ``read_spans`` tolerates, and
+  concurrent appenders (multiple threads; multiple hosts sharing a
+  checkpoint dir) can never interleave mid-record.
+- **Never kill the run it observes.** Every write is wrapped: an I/O error
+  disables the tracer with one warning instead of propagating into the
+  train loop.
+
+Event vocabulary (the Chrome trace-event format's subset we emit):
+
+- ``ph:"X"`` complete spans — ``ts``/``dur`` in microseconds of wall clock
+  (``time.time()`` base, so multi-host lanes align on real time);
+- ``ph:"i"`` instants — point events (collective timeouts, watchdog fires);
+- ``ph:"M"`` metadata — one ``thread_name`` record per (pid, tid), emitted
+  lazily at the thread's first event, so lanes carry the ``trlx-*`` names.
+"""
+
+import json
+import os
+import threading
+import time
+import warnings
+
+__all__ = [
+    "configure",
+    "shutdown",
+    "enabled",
+    "trace_span",
+    "complete",
+    "instant",
+    "read_spans",
+    "SPANS_FILENAME",
+]
+
+SPANS_FILENAME = "spans.jsonl"
+
+
+class _NullSpan:
+    """Shared, reentrant no-op context manager — the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class SpanTracer:
+    """Appends Chrome trace events to one JSONL file, line-atomically."""
+
+    def __init__(self, path: str, process_index: int = 0):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+        self.path = path
+        self.pid = int(process_index)
+        self._file = open(path, "ab", buffering=0)
+        # Synthetic per-thread-OBJECT lane ids, stored thread-locally. Raw
+        # thread.ident would be simpler but the OS reuses idents: a rollout
+        # producer starting after an epoch's prefetch thread exits can
+        # inherit its ident, and the stale thread_name metadata would then
+        # mislabel (and merge) the two lanes in the viewer.
+        self._local = threading.local()
+        self._next_tid = 0
+        self._name_lock = threading.Lock()
+
+    def _emit(self, event: dict):
+        try:
+            # ONE write call per record → line-atomic under O_APPEND.
+            self._file.write((json.dumps(event) + "\n").encode("utf-8"))
+        except (OSError, ValueError):
+            # ValueError: write on a closed file (late event during teardown).
+            # Tracing must never take down the run it observes — disarm.
+            _disarm_on_error(self)
+
+    def _tid(self) -> int:
+        tid = getattr(self._local, "tid", None)
+        if tid is None:
+            with self._name_lock:
+                self._next_tid += 1
+                tid = self._local.tid = self._next_tid
+            self._emit(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self.pid,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                }
+            )
+        return tid
+
+    def complete(self, name: str, t0: float, t1: float, args: dict):
+        self._emit(
+            {
+                "name": name,
+                "ph": "X",
+                "pid": self.pid,
+                "tid": self._tid(),
+                "ts": int(t0 * 1e6),
+                "dur": max(0, int((t1 - t0) * 1e6)),
+                **({"args": args} if args else {}),
+            }
+        )
+
+    def instant(self, name: str, args: dict):
+        self._emit(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "pid": self.pid,
+                "tid": self._tid(),
+                "ts": int(time.time() * 1e6),
+                **({"args": args} if args else {}),
+            }
+        )
+
+    def close(self):
+        try:
+            self._file.close()
+        except OSError:
+            pass
+
+
+# Process-global tracer, armed once by the trainer. A module global (not a
+# trainer attribute) because the emitting sites span orchestrators, pipeline
+# threads, and resilience guards that do not all hold a trainer reference.
+_STATE = {"tracer": None}
+
+
+def _disarm_on_error(tracer):
+    if _STATE["tracer"] is tracer:
+        _STATE["tracer"] = None
+        warnings.warn(
+            f"span tracing disabled: writing {tracer.path} failed "
+            "(disk full / closed file?) — the run continues untraced",
+            stacklevel=3,
+        )
+
+
+def configure(path=None, process_index=0):
+    """Arm (path given) or disarm (path=None) the process-global tracer.
+
+    ``process_index`` becomes the trace's ``pid`` lane group — pass
+    ``jax.process_index()`` so multi-host runs sharing a checkpoint dir get
+    one lane group per host."""
+    old, _STATE["tracer"] = _STATE["tracer"], None
+    if old is not None:
+        old.close()
+    if path:
+        _STATE["tracer"] = SpanTracer(path, process_index=process_index)
+
+
+def shutdown():
+    configure(None)
+
+
+def enabled() -> bool:
+    return _STATE["tracer"] is not None
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._args = dict(self._args or {})
+            self._args["error"] = exc_type.__name__
+        self._tracer.complete(self._name, self._t0, time.time(), self._args)
+        return False
+
+
+def trace_span(name: str, **args):
+    """``with trace_span("rollout/decode", step=n):`` — records one complete
+    span on the calling thread's lane. Returns a shared no-op when tracing
+    is off, so instrumented code pays one dict load on the serial path."""
+    tracer = _STATE["tracer"]
+    if tracer is None:
+        return _NULL_SPAN
+    return _Span(tracer, name, args)
+
+
+def complete(name: str, t0: float, **args):
+    """Emit a span that STARTED at ``t0`` (``time.time()`` seconds) and ends
+    now — for sites that already hold a phase start timestamp (the per-step
+    train span) and must not restructure into a ``with`` block."""
+    tracer = _STATE["tracer"]
+    if tracer is not None:
+        tracer.complete(name, t0, time.time(), args)
+
+
+def instant(name: str, **args):
+    """Emit a point event (watchdog fired, collective timed out, incident)."""
+    tracer = _STATE["tracer"]
+    if tracer is not None:
+        tracer.instant(name, args)
+
+
+def read_spans(path: str):
+    """Parse a spans.jsonl, tolerating a torn final line — the same contract
+    as utils.logging.read_jsonl (a killed writer tears at most the tail;
+    mid-file corruption still raises)."""
+    from trlx_tpu.utils.logging import read_jsonl
+
+    return read_jsonl(path)

@@ -247,7 +247,7 @@ def generate(
     return final["tokens"], final["mask"]
 
 
-def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = (), step_stats_fn: Optional[Callable] = None, apply_kwargs: Optional[dict] = None, prefill_collect: Tuple[str, ...] = ()):
+def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] = None, carry_keys: Tuple[str, ...] = (), step_stats_fn: Optional[Callable] = None, apply_kwargs: Optional[dict] = None, prefill_collect: Tuple[str, ...] = (), monitor=None, monitor_name: str = "rollout/generate"):
     """Build a jitted generate fn of (variables, prompt_ids, prompt_mask, rng).
 
     Call once per (model, gcfg, processor) and reuse — each distinct
@@ -256,6 +256,13 @@ def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] 
     built fn is bound to the mesh active at build time: calling it after a
     set_mesh() swap raises instead of silently tracing/running with a stale
     cache placement.
+
+    ``monitor`` (an observability.DeviceMonitor) wraps the INNER jitted fn —
+    the monitor must see the post-bucketing padded shapes, not the caller's
+    raw prompts, for its compiled-cost capture to hit the executables that
+    actually run. The trace-count hook is unaffected: the monitor's one-time
+    ``lower()`` shares the jit tracing cache, so ``num_traces`` still counts
+    only novel shapes.
     """
     from trlx_tpu.parallel import mesh as mesh_mod
 
@@ -284,6 +291,8 @@ def make_generate_fn(model, gcfg: GenerateConfig, processor: Optional[Callable] 
         return fn(variables, prompt_ids, prompt_mask, rng)
 
     jitted = jax.jit(traced)
+    if monitor is not None:
+        jitted = monitor.wrap(monitor_name, jitted, phase="rollout")
 
     def call(variables, prompt_ids, prompt_mask, rng):
         current = mesh_mod.peek_mesh()

@@ -25,6 +25,7 @@ from collections import deque
 import jax
 import numpy as np
 
+from trlx_tpu.observability.spans import complete as span_complete, trace_span
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
 from trlx_tpu.pipeline.overlap import ScoreWorker
 from trlx_tpu.resilience.faults import FaultInjected
@@ -192,6 +193,7 @@ class PPOOrchestrator(Orchestrator):
                 rows["staleness"] = np.full((q_ids.shape[0], 1), float(staleness), dtype=np.float32)
             store.push_batch(rows)
             push_s += time.time() - t0
+            span_complete("rollout/push", t0, rows=int(q_ids.shape[0]))
 
         def finish_chunk(ctx, scores):
             # Device scoring + pulls + store push for one scored chunk. Runs
@@ -209,6 +211,7 @@ class PPOOrchestrator(Orchestrator):
                 )
             logprobs, values, rewards, kl = rl.to_local_host((logprobs, values, rewards, kl))
             score_s += time.time() - t0
+            span_complete("rollout/score_device", t0, step=iter_count)
             push_rows(ctx["tokens_h"], ctx["mask_h"], ctx["P"], logprobs, values, rewards)
             last_scores, last_kl = np.asarray(scores), kl
 
@@ -224,8 +227,13 @@ class PPOOrchestrator(Orchestrator):
             # timeout wrapper nests fine there — its watchdog is its own
             # daemon thread), inline otherwise.
             tokens_h, mask_h = args
-            texts_or_tokens = rl.decode(tokens_h, mask_h)
-            return np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+            # Lands on whichever thread runs the scoring (the ScoreWorker's
+            # lane when overlap is on, the main lane otherwise) — exactly the
+            # attribution the trace viewer should show.
+            with trace_span("rollout/decode", step=iter_count):
+                texts_or_tokens = rl.decode(tokens_h, mask_h)
+            with trace_span("rollout/reward_fn", step=iter_count):
+                return np.asarray(self.score(texts_or_tokens), dtype=np.float32)
 
         worker = None
         inflight = None
@@ -238,6 +246,7 @@ class PPOOrchestrator(Orchestrator):
         t = time.time()
         pending = self._generate_next_chunk(snapshot=snapshot)
         gen_s += time.time() - t
+        span_complete("rollout/generate", t, step=iter_count, dispatch=True)
         heartbeat = getattr(rl, "heartbeat", None)
         aborted = False
         try:
@@ -274,6 +283,9 @@ class PPOOrchestrator(Orchestrator):
                 # both reward paths and the store push reuse these host rows.
                 tokens_h, mask_h = rl.to_local_host((tokens, mask))
                 gen_s += time.time() - t
+                # Generate-BLOCKED wall (next-chunk dispatch + this chunk's
+                # grid pull): the span twin of the gen_s accounting above.
+                span_complete("rollout/generate", t, step=iter_count)
                 ds = rl.rollout_decode_stats(mask_h, P)
                 gen_tokens += ds["gen_tokens"]
                 decode_steps.append(ds["decode_steps"])
@@ -293,6 +305,7 @@ class PPOOrchestrator(Orchestrator):
                         (logprobs, values, rewards, kl)
                     )
                     score_s += time.time() - t
+                    span_complete("rollout/score_rm", t, step=iter_count)
                     push_rows(tokens_h, mask_h, P, logprobs, values, rewards)
                     last_scores, last_kl = np.asarray(scores), kl
                 elif worker is not None:

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
+from trlx_tpu.observability.spans import trace_span
+
 
 class PhaseTimer:
     """Thread-safe per-phase wall accumulators.
@@ -111,7 +113,8 @@ class ScoreWorker:
                 return
             t0 = time.time()
             try:
-                self._out.put(("ok", self._fn(item)))
+                with trace_span("score/host"):
+                    self._out.put(("ok", self._fn(item)))
             except BaseException as e:  # noqa: BLE001 — delivered via result()
                 self._out.put(("err", e))
             finally:
@@ -197,7 +200,9 @@ class PrefetchIterator:
             for item in it:
                 if self._stop.is_set():
                     return
-                if not self._put(("ok", self._transform(item))):
+                with trace_span("prefetch/stage"):
+                    staged = ("ok", self._transform(item))
+                if not self._put(staged):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised at __next__
             self._put(("err", e))
@@ -290,7 +295,8 @@ class RolloutProducer:
                 staleness = index - self._consumed
             store = self._new_store()
             try:
-                self._produce(store, index, snapshot, staleness, self._should_stop)
+                with trace_span("rollout/produce", index=index, staleness=staleness):
+                    self._produce(store, index, snapshot, staleness, self._should_stop)
             except BaseException as e:  # noqa: BLE001 — re-raised in next_store()
                 with self._cv:
                     self._error = e

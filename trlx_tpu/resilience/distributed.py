@@ -255,6 +255,21 @@ class collective_guard:
                 step = provider()
             except Exception:
                 step = None
+        # Observability last-gasp: an instant on this thread's span lane plus
+        # a best-effort incident bundle (thread stacks name the wedged peer
+        # collective) BEFORE on_timeout — the default handler os._exit()s.
+        try:
+            from trlx_tpu.observability import anomaly as _obs_anomaly
+            from trlx_tpu.observability import spans as _obs_spans
+
+            _obs_spans.instant(
+                "collective_timeout", collective=self.name, deadline_s=self.deadline
+            )
+            _obs_anomaly.emergency_capture(
+                "collective_timeout", detail={"collective": self.name}
+            )
+        except Exception:  # noqa: BLE001 — the abort path must still abort
+            pass
         hb = _CONFIG["heartbeat"]
         detail = (
             stall_report(hb.directory, self.name)
@@ -271,8 +286,13 @@ class collective_guard:
         )
 
     def __enter__(self):
+        self._span_t0 = None
         if self.deadline <= 0:
             return self
+        from trlx_tpu.observability import spans as _obs_spans
+
+        if _obs_spans.enabled():
+            self._span_t0 = time.time()
         hb = _CONFIG["heartbeat"]
         if hb is not None:
             # Mark this host as INSIDE the collective: the stall report can
@@ -287,6 +307,13 @@ class collective_guard:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._span_t0 is not None:
+            from trlx_tpu.observability import spans as _obs_spans
+
+            # A lane of collective/<name> boxes per host: the waiters' spans
+            # stretch toward the deadline, the culprit's never starts.
+            _obs_spans.complete(f"collective/{self.name}", self._span_t0)
+            self._span_t0 = None
         return False
 
 

@@ -20,7 +20,13 @@ What "tick" means is defined by the injection site:
 - ``ckpt_corrupt@N`` — the Nth completed save has its largest file truncated
                        → exercises manifest verification + restore fallback;
 - ``sigterm@N``      — SIGTERM is delivered to this process after step N →
-                       exercises the preemption save/resume path.
+                       exercises the preemption save/resume path;
+- ``slow_step@N``    — this process stalls ``TRLX_TPU_SLOW_STEP_SECONDS``
+                       (default 1) between step N's dispatch and its
+                       log-boundary sync, inflating the measured step_time →
+                       exercises the observability anomaly detector +
+                       incident capture (trlx_tpu/observability/anomaly.py)
+                       on CPU.
 
 Multi-host kinds (fired per PROCESS — a 2-process drill sets a different
 ``TRLX_TPU_FAULTS`` on each worker; tests/test_distributed_resilience.py):
@@ -54,6 +60,7 @@ KINDS = (
     "reward_hang",
     "ckpt_corrupt",
     "sigterm",
+    "slow_step",
     "host_hang",
     "host_kill",
     "slow_host",

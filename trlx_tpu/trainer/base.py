@@ -37,6 +37,8 @@ from transformers import AutoTokenizer
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import trainable_mask
+from trlx_tpu import observability as obs
+from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
 from trlx_tpu.parallel.mesh import DATA_AXES, barrier, init_distributed, is_main_process
 from trlx_tpu.resilience import (
@@ -112,6 +114,19 @@ class JaxBaseTrainer(BaseRLTrainer):
             # backend init; programs compiled earlier in the process simply
             # weren't cached.
             os.makedirs(config.train.compile_cache_dir, exist_ok=True)
+            # The persistent-cache backend binds at the FIRST compile of the
+            # process — including to "no directory" when the dir was unset
+            # then — and a later jax.config.update of the dir alone is
+            # ignored for the rest of the process (observed as the
+            # order-dependent test_compile_cache_dir_populates flake). Reset
+            # the backend whenever this trainer's dir differs from what the
+            # process may have initialized with (None included) so its
+            # programs land where ITS config points.
+            prev_dir = jax.config.jax_compilation_cache_dir
+            if prev_dir != config.train.compile_cache_dir:
+                from jax.experimental.compilation_cache import compilation_cache as _cc
+
+                _cc.reset_cache()
             jax.config.update("jax_compilation_cache_dir", config.train.compile_cache_dir)
             # 0.0, not a threshold: production programs all compile >1s, and
             # a threshold would silently skip caching small test/dev models
@@ -218,6 +233,50 @@ class JaxBaseTrainer(BaseRLTrainer):
             log_dir=config.train.checkpoint_dir,
         )
 
+        # ---- observability (trlx_tpu/observability/): span tracing, device
+        # telemetry, anomaly capture. Env flags override config so a drill
+        # can be bolted onto any run command; everything defaults OFF and the
+        # instrumentation stays off the hot dispatch path.
+        ckpt_dir = os.path.abspath(config.train.checkpoint_dir)
+        if config.train.trace_spans or obs.env_flag("TRLX_TPU_SPANS"):
+            obs_spans.configure(
+                os.path.join(ckpt_dir, obs_spans.SPANS_FILENAME),
+                process_index=jax.process_index(),
+            )
+        else:
+            # Trainer construction owns the process-global tracer: a prior
+            # trainer in this process (tests build several) must not keep
+            # appending this run's thread spans to its old file.
+            obs_spans.shutdown()
+        self._devicemon = None
+        if config.train.device_telemetry or obs.env_flag("TRLX_TPU_DEVICE_TELEMETRY"):
+            self._devicemon = obs.DeviceMonitor(
+                programs_path=(
+                    os.path.join(ckpt_dir, "programs.json") if is_main_process() else None
+                )
+            )
+        anomaly_factor = float(
+            os.environ.get("TRLX_TPU_ANOMALY_FACTOR", "") or config.train.anomaly_factor
+        )
+        self._anomaly = None
+        self._incidents = None
+        if anomaly_factor > 0:
+            self._anomaly = obs.AnomalyDetector(
+                anomaly_factor, window=config.train.anomaly_window
+            )
+            self._incidents = obs.IncidentCapture(
+                ckpt_dir,
+                monitor=self._devicemon,
+                metrics_path=os.path.join(ckpt_dir, "metrics.jsonl"),
+                max_incidents=config.train.max_incidents,
+                profiling_active=lambda: getattr(self, "_profiling", False),
+            )
+            # The collective-timeout abort path runs on a timer thread with
+            # no trainer reference — register the capture for it.
+            obs.anomaly.register_emergency(
+                self._incidents, lambda: getattr(self, "iter_count", 0)
+            )
+
         self.reward_fn = kwargs.pop("reward_fn", None)
         self.metric_fn = kwargs.pop("metric_fn", None)
         self.logit_mask = kwargs.pop("logit_mask", None)
@@ -288,7 +347,30 @@ class JaxBaseTrainer(BaseRLTrainer):
         never on the hot path."""
         self.optimizer = self._build_optimizer()
         if getattr(self, "train_step", None) is not None:
-            self.train_step = self.build_train_step()
+            self.train_step = self._wrap_monitored("train/step", self.build_train_step())
+
+    def _wrap_monitored(self, name: str, fn, phase: str = "train"):
+        """Route a jitted fn through the device-telemetry monitor — identity
+        when telemetry is off, so call sites stay unconditional. getattr:
+        subclass __init__ code may build programs before the base bootstrap
+        has armed the monitor."""
+        monitor = getattr(self, "_devicemon", None)
+        if monitor is None:
+            return fn
+        return monitor.wrap(name, fn, phase=phase)
+
+    def _flush_device_telemetry(self, phase_seconds: dict) -> dict:
+        """Window-boundary telemetry flush: drain the monitor's per-phase
+        FLOP accumulators into MFU/throughput gauges and sample the
+        kernel-routing + device-memory gauges. Returns {} when telemetry is
+        off — callers merge unconditionally."""
+        monitor = getattr(self, "_devicemon", None)
+        if monitor is None:
+            return {}
+        out = monitor.window(phase_seconds)
+        out.update(monitor.kernel_routing_gauges())
+        out.update(monitor.device_memory_gauges())
+        return out
 
     def build_trainable_mask(self, init_params):
         """Default layer-freezing mask (num_layers_unfrozen); subclasses
@@ -678,6 +760,10 @@ class JaxBaseTrainer(BaseRLTrainer):
         profile_dir = self.config.train.profile_dir
         self._profiling = False
         learn_start = self.iter_count
+        # Device-telemetry window anchor for trainers without a phase timer:
+        # the first MFU window must span from HERE (covering every dispatch
+        # whose FLOPs the monitor accumulated), not just the last step.
+        self._telemetry_t0 = time.time()
 
         def profiler_tick():
             if not profile_dir or not is_main_process():
@@ -725,6 +811,10 @@ class JaxBaseTrainer(BaseRLTrainer):
             # (manifest, latest.txt) only land at finalize, so the exit path
             # must drain it or the checkpoint is invisible to resume.
             self._finalize_pending_save()
+            if self._devicemon is not None:
+                # Final registry persist: dispatches since the last window
+                # boundary must still show in programs.json for the report.
+                self._devicemon.flush()
             if self._profiling:
                 jax.profiler.stop_trace()
             if handler_installed:
@@ -890,6 +980,37 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # make the logged throughput wrong by orders of
                         # magnitude on eval steps.
                         stats_host["step_time"] = time.time() - forward_t0
+                        # Span for the logged step (dispatch + the stats
+                        # sync above) on the main thread's lane — against the
+                        # producer/score lanes this is where overlap shows.
+                        obs_spans.complete(
+                            "train/step", forward_t0, step=self.iter_count
+                        )
+                        if self._anomaly is not None and self._anomaly.observe(
+                            stats_host["step_time"]
+                        ):
+                            self._incidents.capture(
+                                self.iter_count,
+                                "slow_step",
+                                detail={
+                                    "step_time": stats_host["step_time"],
+                                    "p50": self._anomaly.p50(),
+                                    "factor": self._anomaly.factor,
+                                },
+                            )
+                        if self._devicemon is not None and getattr(self, "_phase_timer", None) is None:
+                            # Trainers without a phase timer (ILQL) flush the
+                            # device telemetry here; PPO flushes at its
+                            # rollout-window boundary (_log_phase_window)
+                            # where the true per-phase seconds live.
+                            now = time.time()
+                            since = now - getattr(self, "_telemetry_t0", forward_t0)
+                            self._telemetry_t0 = now
+                            stats_host.update(
+                                self._flush_device_telemetry(
+                                    {"train": since, "wall": since}
+                                )
+                            )
                         stats_host["samples_per_sec"] = (
                             self.config.train.batch_size / max(stats_host["step_time"], 1e-9)
                         )
@@ -1042,11 +1163,22 @@ class JaxBaseTrainer(BaseRLTrainer):
                 # Remaining observations predate the rollback — drop them.
                 self._rollback()
                 return
-        if self.skipped_steps != skips_before and getattr(self, "tracker", None) is not None:
-            self.tracker.log(
-                {"resilience/skipped_steps": float(self.skipped_steps)},
-                step=self.iter_count,
+        if self.skipped_steps != skips_before:
+            obs_spans.instant(
+                "guard_skip", step=self.iter_count, skipped=int(self.skipped_steps)
             )
+            incidents = getattr(self, "_incidents", None)
+            if incidents is not None:
+                incidents.capture(
+                    self.iter_count,
+                    "guard_skip",
+                    detail={"skipped_steps": int(self.skipped_steps)},
+                )
+            if getattr(self, "tracker", None) is not None:
+                self.tracker.log(
+                    {"resilience/skipped_steps": float(self.skipped_steps)},
+                    step=self.iter_count,
+                )
 
     def _fire_host_faults(self):
         """Per-PROCESS fault drills (trlx_tpu/resilience/faults.py): each
@@ -1056,6 +1188,13 @@ class JaxBaseTrainer(BaseRLTrainer):
         if not self.fault_plan:
             return
         step = self.iter_count
+        if self.fault_plan.fire("slow_step", step):
+            # Synthetic straggler STEP (vs. slow_host's straggler HOST): the
+            # stall sits between this step's dispatch and its log-boundary
+            # stats sync, so the measured step_time inflates past the
+            # anomaly detector's rolling-p50 gate — the CPU drill for the
+            # incident-capture path (step N must be a logged step).
+            time.sleep(float(os.environ.get("TRLX_TPU_SLOW_STEP_SECONDS", "1")))
         if self.fault_plan.fire("slow_host", step):
             # Straggler, not a death: long enough to dominate a stall
             # report, short enough (vs. a sane deadline) not to abort.
@@ -1097,6 +1236,17 @@ class JaxBaseTrainer(BaseRLTrainer):
         """Divergence watchdog response: restore the last intact checkpoint,
         decay the LR, and resume — aborting after ``train.max_rollbacks``."""
         self._rollbacks += 1
+        # Capture BEFORE the restore mutates state (and before the
+        # max_rollbacks abort below): the bundle's thread stacks / memory
+        # show the run AT the divergence, which is what post-mortems need.
+        obs_spans.instant("watchdog_rollback", step=self.iter_count)
+        incidents = getattr(self, "_incidents", None)
+        if incidents is not None:
+            incidents.capture(
+                self.iter_count,
+                "watchdog_rollback",
+                detail={"rollbacks": int(self._rollbacks)},
+            )
         t = self.config.train
         if self._rollbacks > t.max_rollbacks:
             raise TrainingDiverged(
@@ -1151,6 +1301,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         construction: latest.txt is only repointed AFTER the data is fully
         committed, so a crash mid-async-save leaves the previous checkpoint
         as the resume point."""
+        save_t0 = time.time()
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         self._finalize_pending_save()  # at most one save in flight
         name = f"state_{int(jax.device_get(self.state.step))}"
@@ -1167,6 +1318,10 @@ class JaxBaseTrainer(BaseRLTrainer):
         self._ckptr.save(os.path.join(directory, name), self.state, force=True)
         if block:
             self._finalize_pending_save()
+        # Covers exactly the wall the train loop PAID: through finalize when
+        # blocking, dispatch-only when async (the deferred commit then shows
+        # up as its own ckpt/finalize span).
+        obs_spans.complete("ckpt/save", save_t0, ckpt=name, blocking=bool(block))
 
     def _finalize_pending_save(self):
         """Drain the in-flight async save: wait for the orbax commit, then
@@ -1176,6 +1331,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         pending, self._pending_save = self._pending_save, None
         if pending is None:
             return None
+        fin_t0 = time.time()
         directory, name = pending["directory"], pending["name"]
         self._ckptr.wait_until_finished()
         if jax.process_count() > 1:
@@ -1215,6 +1371,7 @@ class JaxBaseTrainer(BaseRLTrainer):
             # save, exits) until rank 0's pointer flip is durable — every
             # host's view of "the save is done" includes latest.txt.
             barrier(f"ckpt_visible_{name}")
+        obs_spans.complete("ckpt/finalize", fin_t0, ckpt=name)
         return name
 
     def save_pretrained(self, out_dir: str, family: Optional[str] = None):
@@ -1280,6 +1437,7 @@ class JaxBaseTrainer(BaseRLTrainer):
         checkpoint used to produce."""
         import json
 
+        load_t0 = time.time()
         self._finalize_pending_save()  # a pending async save IS the latest
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
         latest_path = os.path.join(directory, "latest.txt")
@@ -1358,6 +1516,9 @@ class JaxBaseTrainer(BaseRLTrainer):
                 if os.path.exists(host_file):
                     with open(host_file) as f:
                         self.load_host_state(json.load(f))
+                obs_spans.complete(
+                    "ckpt/load", load_t0, ckpt=name, fallback=bool(i > 0)
+                )
                 return self.state
 
         raise CheckpointError(

@@ -64,9 +64,13 @@ class ILQLTrainer(JaxBaseTrainer):
             processor=self._make_ilql_processor(),
             carry_keys=("qs", "vs"),
             step_stats_fn=self._decode_step_stats,
+            monitor=getattr(self, "_devicemon", None),
+            monitor_name="rollout/generate",
         )
-        self.train_step = self.build_train_step()
-        self._sync_fn = jax.jit(self._polyak_sync, donate_argnums=(1,))
+        self.train_step = self._wrap_monitored("train/step", self.build_train_step())
+        self._sync_fn = self._wrap_monitored(
+            "train/polyak_sync", jax.jit(self._polyak_sync, donate_argnums=(1,))
+        )
 
     # ----------------------------------------------------------------- setup
 

@@ -170,7 +170,13 @@ class PPOTrainer(JaxBaseTrainer):
             def processor(logits, state):
                 return process_logits_default(bigram(logits, state), gcfg, state["step"])
 
-        self._generate_fn = make_generate_fn(self.model, self.gen_cfg, processor)
+        self._generate_fn = make_generate_fn(
+            self.model,
+            self.gen_cfg,
+            processor,
+            monitor=getattr(self, "_devicemon", None),
+            monitor_name="rollout/generate",
+        )
         # Rollout scoring compiles per prompt width: prompt_length is a
         # STATIC argument (it sets slice boundaries inside the program), so
         # bucketed rollouts key a dict of jitted score fns by P — at most one
@@ -188,7 +194,9 @@ class PPOTrainer(JaxBaseTrainer):
         if getattr(config.model, "decode_weight_quant", False):
             from trlx_tpu.models.lm import quantize_weights
 
-            self._quantize_fn = jax.jit(quantize_weights)
+            self._quantize_fn = self._wrap_monitored(
+                "rollout/quantize", jax.jit(quantize_weights), phase="rollout"
+            )
             self._qw = self._quantize_fn(self.state.params)
 
         # Fused rollout statistics: the decode loop ALREADY computes every
@@ -244,6 +252,8 @@ class PPOTrainer(JaxBaseTrainer):
                 step_stats_fn=rollout_stats_fn,
                 apply_kwargs={"collect_branch_hidden": True},
                 prefill_collect=("branch_hidden",),
+                monitor=getattr(self, "_devicemon", None),
+                monitor_name="rollout/generate_fused",
             )
 
         # On-device learned reward model: a second LM + scalar head, sharded
@@ -257,9 +267,11 @@ class PPOTrainer(JaxBaseTrainer):
             from trlx_tpu.parallel import shard_pytree
 
             self.rm_params, _ = shard_pytree(rm_host_params, self.mesh)
-            self._rm_eval_fn = jax.jit(self._rm_scores)
+            self._rm_eval_fn = self._wrap_monitored(
+                "eval/rm_scores", jax.jit(self._rm_scores), phase="score"
+            )
 
-        self.train_step = self.build_train_step()
+        self.train_step = self._wrap_monitored("train/step", self.build_train_step())
 
     # ----------------------------------------------------------------- setup
 
@@ -418,21 +430,33 @@ class PPOTrainer(JaxBaseTrainer):
     def _score_fn_for(self, P: int):
         fn = self._score_fns.get(P)
         if fn is None:
-            fn = jax.jit(partial(self._rollout_score_impl, prompt_length=P))
+            fn = self._wrap_monitored(
+                f"rollout/score[P={P}]",
+                jax.jit(partial(self._rollout_score_impl, prompt_length=P)),
+                phase="score",
+            )
             self._score_fns[P] = fn
         return fn
 
     def _score_fused_fn_for(self, P: int):
         fn = self._score_fused_fns.get(P)
         if fn is None:
-            fn = jax.jit(partial(self._rollout_score_fused_impl, prompt_length=P))
+            fn = self._wrap_monitored(
+                f"rollout/score_fused[P={P}]",
+                jax.jit(partial(self._rollout_score_fused_impl, prompt_length=P)),
+                phase="score",
+            )
             self._score_fused_fns[P] = fn
         return fn
 
     def _score_rm_fn_for(self, P: int):
         fn = self._score_rm_fns.get(P)
         if fn is None:
-            fn = jax.jit(partial(self._rollout_score_rm_impl, prompt_length=P))
+            fn = self._wrap_monitored(
+                f"rollout/score_rm[P={P}]",
+                jax.jit(partial(self._rollout_score_rm_impl, prompt_length=P)),
+                phase="score",
+            )
             self._score_rm_fns[P] = fn
         return fn
 
@@ -698,6 +722,24 @@ class PPOTrainer(JaxBaseTrainer):
             stats["train_batch_fill"] = float(np.mean(window_fill))
         if self._last_exp_stats:
             stats.update(self._last_exp_stats)
+        # Device telemetry flushes on the SAME cadence as the phase window —
+        # its per-phase FLOP accumulators divide by exactly these seconds, so
+        # obs/train_mfu_pct is the window's true utilization, not a smoothed
+        # proxy.
+        stats.update(
+            self._flush_device_telemetry(
+                {
+                    "train": stats.get("time/train_s", 0.0),
+                    "rollout": stats.get("time/rollout_s", 0.0),
+                    "score": stats.get("time/score_s", 0.0),
+                    "wall": stats.get("time/window_wall_s", 0.0),
+                }
+            )
+        )
+        if jax.process_count() > 1 and self._devicemon is not None:
+            from trlx_tpu.observability.report import rollup_window_stats
+
+            stats.update(rollup_window_stats(stats))
         self._last_phase_stats = stats
         self.tracker.log(stats, step=self.iter_count)
 

@@ -83,6 +83,7 @@ class Tracker:
         self.enabled = is_main_process() and not _tracker_disabled()
         self._wandb = None
         self._file = None
+        self._stringified_keys = set()  # warned-once registry (log())
         if not self.enabled:
             return
         if _HAS_WANDB:
@@ -109,6 +110,19 @@ class Tracker:
             try:
                 scalars[k] = float(v)
             except (TypeError, ValueError):
+                # Stringified, not dropped — but say so ONCE per key: a
+                # non-numeric value under a metric name is usually a caller
+                # bug (an array that needed a reduction, a dict that leaked)
+                # and silently storing "'[1 2 3]'" hides it from every
+                # downstream plot.
+                if k not in self._stringified_keys:
+                    self._stringified_keys.add(k)
+                    warnings.warn(
+                        f"Tracker.log: value for {k!r} is not a scalar "
+                        f"({type(v).__name__}) — logged as its str(); reduce "
+                        "it to a float before logging to make it plottable",
+                        stacklevel=2,
+                    )
                 scalars[k] = str(v)
         if self._wandb is not None:
             self._wandb.log(scalars, step=step)
@@ -149,7 +163,9 @@ class Tracker:
                 "mean": float(values.mean()),
                 "std": float(values.std()),
                 "min": float(values.min()),
+                "p5": float(np.percentile(values, 5)),
                 "p50": float(np.median(values)),
+                "p95": float(np.percentile(values, 95)),
                 "max": float(values.max()),
             }
         )
