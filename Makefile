@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke obs-smoke acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -61,9 +61,18 @@ bench-reference:
 bench-smoke:
 	$(TEST_ENV) python bench_smoke.py
 
+# Bench-trajectory regression gate, stdlib-only, seconds: folds the tracked
+# BENCH_r0*.json / BENCH_SMOKE.json artifacts into BENCH_TRAJECTORY.json and
+# exits 1 when samples/s/chip or train MFU regresses >10% vs the best prior
+# run with the same bench config. Non-blocking CI job.
+bench-trajectory:
+	python bench_trajectory.py
+
 # CPU observability smoke, ~1 min: a short overlapped PPO run with span
-# tracing, device telemetry, and the slow_step anomaly drill armed, then the
-# report renderer over the artifacts. Writes OBS_SMOKE.json + OBS_REPORT.md.
+# tracing, device telemetry, the slow_step anomaly drill, the health monitor
+# with the reward_drift drill, and the live /metrics exporter armed (scraped
+# from a background thread mid-run), then the report renderer over the
+# artifacts. Writes OBS_SMOKE.json + OBS_REPORT.md + OBS_METRICS.prom.
 obs-smoke:
 	$(TEST_ENV) python obs_smoke.py
 

@@ -1,0 +1,170 @@
+"""Bench-trajectory regression gate: fold the per-run bench artifacts into
+one tracked series and fail when the flagship numbers move backwards.
+
+    python bench_trajectory.py [--check/--no-check] [--tolerance 0.10]
+                               [--out BENCH_TRAJECTORY.json]
+
+Inputs (all already tracked in the repo root):
+
+- ``BENCH_r0*.json`` — one file per bench run (the ``parsed`` block carries
+  ``value`` in samples/s/chip and, from r02 on, ``train_mfu_pct``). Runs
+  whose parse failed but whose ``tail`` still contains the bench's JSON
+  metric line are recovered from the tail; runs with no data at all are
+  recorded as gaps, not silently dropped.
+- ``BENCH_SMOKE.json`` — the CPU smoke's informational throughputs
+  (rollout/fused-loss tokens/s, overlap fraction). Folded into the series
+  for trend reading, never gated: CPU smoke numbers measure the harness,
+  not the hardware.
+
+Output: ``BENCH_TRAJECTORY.json`` — the full series plus the gate verdict.
+
+The gate compares the LATEST run carrying data against the BEST prior run
+with the SAME ``metric`` string (bench configs changed across early runs —
+r01 benched a small arch; comparing across configs would be noise): exit 1
+when samples/s/chip or train MFU regresses more than ``--tolerance``
+(default 10%). Wired as a non-blocking CI job (.github/workflows/tests.yml)
+so the trajectory informs without gating merges. Stdlib-only on purpose —
+the CI job needs no installs.
+"""
+
+import argparse
+import glob
+import json
+import re
+import sys
+
+RUN_GLOB = "BENCH_r[0-9]*.json"
+SMOKE_PATH = "BENCH_SMOKE.json"
+
+
+def _parse_run(path: str):
+    """One trajectory entry per bench-run artifact. ``parsed`` when the
+    harness extracted the metric line; otherwise scrape the tail for the
+    bench's own JSON line; otherwise a data-less gap entry."""
+    try:
+        with open(path) as f:
+            run = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"source": path, "error": f"{type(e).__name__}: {e}"}
+    m = re.search(r"r(\d+)", path)
+    entry = {"source": path, "run": int(m.group(1)) if m else None, "rc": run.get("rc")}
+    parsed = run.get("parsed")
+    if not parsed:
+        for line in reversed(run.get("tail", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    parsed = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+    if not parsed or not isinstance(parsed.get("value"), (int, float)):
+        entry["no_data"] = True
+        return entry
+    entry["metric"] = parsed.get("metric")
+    entry["samples_per_sec_per_chip"] = float(parsed["value"])
+    if isinstance(parsed.get("train_mfu_pct"), (int, float)):
+        entry["train_mfu_pct"] = float(parsed["train_mfu_pct"])
+    return entry
+
+
+def _parse_smoke(path: str):
+    try:
+        with open(path) as f:
+            smoke = json.load(f)
+    except (OSError, ValueError):
+        return None
+    out = {"source": path}
+    rollout = smoke.get("rollout", {})
+    fused = smoke.get("fused_loss", {})
+    overlap = smoke.get("overlap", {})
+    if isinstance(rollout.get("tokens_per_s"), (int, float)):
+        out["rollout_tokens_per_s"] = float(rollout["tokens_per_s"])
+    if isinstance(fused.get("tokens_per_s"), (int, float)):
+        out["fused_loss_tokens_per_s"] = float(fused["tokens_per_s"])
+    if isinstance(overlap.get("overlap_fraction_max"), (int, float)):
+        out["overlap_fraction_max"] = float(overlap["overlap_fraction_max"])
+    return out
+
+
+def build_trajectory(run_paths, smoke_path=SMOKE_PATH, tolerance: float = 0.10):
+    runs = [_parse_run(p) for p in sorted(run_paths)]
+    with_data = [r for r in runs if "samples_per_sec_per_chip" in r]
+    trajectory = {
+        "runs": runs,
+        "smoke": _parse_smoke(smoke_path),
+        "tolerance": tolerance,
+        "regressed": False,
+        "verdict": [],
+    }
+    if not with_data:
+        trajectory["verdict"].append("no bench runs carry data — nothing to gate")
+        return trajectory
+    latest = with_data[-1]
+    trajectory["latest"] = latest
+    priors = [r for r in with_data[:-1] if r.get("metric") == latest.get("metric")]
+    if not priors:
+        trajectory["verdict"].append(
+            f"latest run {latest['source']} has no prior run with the same "
+            "metric config — trajectory seeded, nothing to gate"
+        )
+        return trajectory
+    best = max(priors, key=lambda r: r["samples_per_sec_per_chip"])
+    trajectory["best_prior"] = best
+    floor = (1.0 - tolerance) * best["samples_per_sec_per_chip"]
+    if latest["samples_per_sec_per_chip"] < floor:
+        trajectory["regressed"] = True
+        trajectory["verdict"].append(
+            f"REGRESSION: samples/s/chip {latest['samples_per_sec_per_chip']:.3f} "
+            f"({latest['source']}) is more than {tolerance:.0%} below the best prior "
+            f"{best['samples_per_sec_per_chip']:.3f} ({best['source']})"
+        )
+    else:
+        trajectory["verdict"].append(
+            f"samples/s/chip {latest['samples_per_sec_per_chip']:.3f} vs best prior "
+            f"{best['samples_per_sec_per_chip']:.3f} — within tolerance"
+        )
+    mfu_priors = [r for r in priors if "train_mfu_pct" in r]
+    if "train_mfu_pct" in latest and mfu_priors:
+        best_mfu = max(r["train_mfu_pct"] for r in mfu_priors)
+        if latest["train_mfu_pct"] < (1.0 - tolerance) * best_mfu:
+            trajectory["regressed"] = True
+            trajectory["verdict"].append(
+                f"REGRESSION: train MFU {latest['train_mfu_pct']:.2f}% is more than "
+                f"{tolerance:.0%} below the best prior {best_mfu:.2f}%"
+            )
+        else:
+            trajectory["verdict"].append(
+                f"train MFU {latest['train_mfu_pct']:.2f}% vs best prior "
+                f"{best_mfu:.2f}% — within tolerance"
+            )
+    return trajectory
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="BENCH_TRAJECTORY.json")
+    parser.add_argument("--tolerance", type=float, default=0.10)
+    parser.add_argument(
+        "--no-check",
+        action="store_true",
+        help="fold the trajectory but always exit 0 (local refresh)",
+    )
+    args = parser.parse_args(argv)
+
+    trajectory = build_trajectory(
+        glob.glob(RUN_GLOB), smoke_path=SMOKE_PATH, tolerance=args.tolerance
+    )
+    with open(args.out, "w") as f:
+        json.dump(trajectory, f, indent=1)
+        f.write("\n")
+    for line in trajectory["verdict"]:
+        print(line)
+    print(f"wrote {args.out} ({len(trajectory['runs'])} runs)")
+    if trajectory["regressed"] and not args.no_check:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

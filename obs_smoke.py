@@ -11,11 +11,18 @@ with every observability surface armed:
   kernel-routing gauges, and programs.json must register the train step;
 - anomaly capture (train.anomaly_factor + the TRLX_TPU_FAULTS=slow_step
   drill): an incident bundle with thread stacks must land;
+- training health (train.health_monitor + the reward_drift drill): the
+  reward-drift detector must walk OK→WARN→CRIT, escalate a
+  health_reward_drift incident bundle, and leave lineage.jsonl behind;
+- live exporter (train.metrics_port): /metrics must serve the health/*
+  gauges in Prometheus text format and /healthz must report degraded
+  WHILE the run is alive (scraped from a background thread);
 - reporting: trlx_tpu.observability.report must render every section from
   the run's artifacts and export the chrome://tracing JSON.
 
-Writes OBS_SMOKE.json + OBS_REPORT.md and prints one JSON summary line;
-exits 1 on any failure. Wall time ~1 min on a laptop CPU.
+Writes OBS_SMOKE.json + OBS_REPORT.md + OBS_METRICS.prom (the last live
+scrape) and prints one JSON summary line; exits 1 on any failure. Wall
+time ~1 min on a laptop CPU.
 """
 
 import json
@@ -28,6 +35,56 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.abspath(__file__))
 OUT = os.path.join(REPO, "OBS_SMOKE.json")
 REPORT_OUT = os.path.join(REPO, "OBS_REPORT.md")
+METRICS_OUT = os.path.join(REPO, "OBS_METRICS.prom")
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Scraper:
+    """Background poller proving the endpoint is LIVE during the run: keeps
+    the last successful /metrics text and the worst /healthz status seen."""
+
+    def __init__(self, port):
+        import threading
+
+        self.port = port
+        self.metrics_text = ""
+        self.worst_status = None
+        self.scrapes = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import urllib.request
+
+        rank = {"ok": 0, "degraded": 1, "critical": 2}
+        while not self._stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/metrics", timeout=1
+                ) as r:
+                    self.metrics_text = r.read().decode()
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}/healthz", timeout=1
+                ) as r:
+                    status = json.loads(r.read().decode()).get("status")
+                self.scrapes += 1
+                if rank.get(status, -1) > rank.get(self.worst_status, -1):
+                    self.worst_status = status
+            except OSError:
+                pass  # exporter not up yet / torn down
+            self._stop.wait(0.05)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
 
 
 def observability_probe():
@@ -36,7 +93,9 @@ def observability_probe():
 
     import numpy as np
 
-    os.environ["TRLX_TPU_FAULTS"] = "slow_step@6"
+    # slow_step drills the anomaly detector; reward_drift (from reward call
+    # 2 on — call 1 seeds the warmup baseline) drills the health monitor.
+    os.environ["TRLX_TPU_FAULTS"] = "slow_step@6,reward_drift@2"
     os.environ["TRLX_TPU_SLOW_STEP_SECONDS"] = "1.5"
     os.environ["TRLX_TPU_PEAK_TFLOPS"] = "0.01"
 
@@ -56,23 +115,37 @@ def observability_probe():
     config.train.trace_spans = True
     config.train.device_telemetry = True
     config.train.anomaly_factor = 3.0
+    # Health monitor: chunk_size=8 gives 2 reward calls per store, so the
+    # drift walk is obs1 clean baseline (warmup=1) → obs2 drifted WARN
+    # (warn_streak=1) → obs3 drifted CRIT (crit_streak=2), all in the first
+    # few seconds — the exporter then serves CRIT for the rest of the run.
+    config.train.health_monitor = True
+    config.train.health_warmup = 1
+    config.train.health_warn_streak = 1
+    config.train.health_crit_streak = 2
+    port = _free_port()
+    config.train.metrics_port = port
     config.method.num_rollouts = 16
-    config.method.chunk_size = 16
+    config.method.chunk_size = 8
     config.method.max_staleness = 1
     d = tempfile.mkdtemp(prefix="obs_smoke_")
     config.train.checkpoint_dir = d
     prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
 
+    scraper = _Scraper(port)
     t0 = time.time()
-    model = trlx_tpu.train(
-        reward_fn=reward_fn,
-        prompts=prompts,
-        eval_prompts=[[1]],
-        metric_fn=metric_fn,
-        config=config,
-        logit_mask=logit_mask,
-    )
-    wall_s = time.time() - t0
+    try:
+        model = trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=[[1]],
+            metric_fn=metric_fn,
+            config=config,
+            logit_mask=logit_mask,
+        )
+    finally:
+        wall_s = time.time() - t0
+        scraper.stop()
     assert model.iter_count >= 8
     leaked = [t.name for t in threading.enumerate() if t.name.startswith("trlx-")]
     assert not leaked, f"pipeline threads leaked: {leaked}"
@@ -107,25 +180,61 @@ def observability_probe():
         programs = json.load(f)
     assert "train/step" in programs and programs["train/step"]["dispatches"] >= 8
 
-    # --- anomaly: the slow_step drill produced a bundle -------------------
+    # --- anomaly + health escalation: both drills produced bundles --------
     incidents_dir = os.path.join(d, "incidents")
     bundles = sorted(os.listdir(incidents_dir)) if os.path.isdir(incidents_dir) else []
-    assert bundles, "slow_step drill produced no incident bundle"
-    with open(os.path.join(incidents_dir, bundles[0], "incident.json")) as f:
-        manifest = json.load(f)
-    assert manifest["reason"] == "slow_step", manifest
-    assert manifest["sections"]["threads"] == "ok", manifest["sections"]
-    with open(os.path.join(incidents_dir, bundles[0], "threads.txt")) as f:
+    reasons = {}
+    for b in bundles:
+        with open(os.path.join(incidents_dir, b, "incident.json")) as f:
+            reasons[json.load(f)["reason"]] = b
+    assert "slow_step" in reasons, f"slow_step drill produced no bundle: {reasons}"
+    assert "health_reward_drift" in reasons, (
+        f"reward_drift CRIT did not escalate into an incident: {reasons}"
+    )
+    with open(os.path.join(incidents_dir, reasons["slow_step"], "threads.txt")) as f:
         assert "trlx-" in f.read(), "pipeline threads absent from stack dump"
+
+    # --- health: detector walked to CRIT, lineage landed ------------------
+    drift_states = [
+        r["health/reward_drift_state"]
+        for r in records
+        if "health/reward_drift_state" in r
+    ]
+    assert drift_states and max(drift_states) == 2, (
+        f"reward_drift detector never reached CRIT: {drift_states}"
+    )
+    changes = [
+        r["health/state_changes_total"]
+        for r in records
+        if "health/state_changes_total" in r
+    ]
+    assert changes and changes[-1] >= 2, f"state-change counter: {changes}"
+    with open(os.path.join(d, "lineage.jsonl")) as f:
+        lineage = [json.loads(line) for line in f]
+    assert lineage and all("weight_version" in r and "staleness" in r for r in lineage)
+
+    # --- live exporter: scraped DURING the run ----------------------------
+    assert scraper.scrapes > 0, "never scraped the live /metrics endpoint"
+    prom = scraper.metrics_text
+    assert "# TYPE trlx_tpu_health_reward_drift_state gauge" in prom, prom[:2000]
+    assert "# TYPE trlx_tpu_health_state_changes_total counter" in prom
+    assert scraper.worst_status in ("degraded", "critical"), scraper.worst_status
+    with open(METRICS_OUT, "w") as f:
+        f.write(prom)
 
     # --- report: renders every section + exports the trace ----------------
     trace_out = os.path.join(d, "trace.json")
     assert report.main([d, "-o", REPORT_OUT, "--trace-out", trace_out]) == 0
     with open(REPORT_OUT) as f:
         md = f.read()
-    for heading in ("## Span lanes", "## MFU / FLOP throughput", "## Incidents"):
+    for heading in (
+        "## Span lanes",
+        "## MFU / FLOP throughput",
+        "## Training health",
+        "## Incidents",
+    ):
         assert heading in md, f"report section missing: {heading}"
-    assert "slow_step" in md
+    assert "slow_step" in md and "health_reward_drift" in md
 
     return {
         "steps": model.iter_count,
@@ -134,7 +243,10 @@ def observability_probe():
         "producer_train_overlap_s": round(overlap_s, 2),
         "mfu_windows": len(mfu),
         "mfu_last_pct": round(mfu[-1], 3),
-        "incident": f"incidents/{bundles[0]}",
+        "incidents": reasons,
+        "health_worst_status": scraper.worst_status,
+        "live_scrapes": scraper.scrapes,
+        "lineage_rows": len(lineage),
         "report_bytes": len(md),
         "seconds": round(wall_s, 2),
     }

@@ -209,6 +209,25 @@ class TrainConfig:
     # per-run cap on captured incident bundles.
     anomaly_window: int = 64
     max_incidents: int = 4
+    # Training-health monitor (trlx_tpu/observability/health.py): streaming
+    # detectors — reward drift vs a warmup baseline, KL-controller health,
+    # entropy collapse, value explained variance, degenerate-rollout
+    # sentinels — each with OK/WARN/CRIT hysteresis, health/* gauges in
+    # metrics.jsonl, per-chunk lineage records in lineage.jsonl, and CRIT
+    # escalation into the incident bundles. TRLX_TPU_HEALTH=1 overrides.
+    health_monitor: bool = False
+    # Observations the baseline-relative detectors (reward drift, entropy,
+    # KL, explained variance) absorb before judging.
+    health_warmup: int = 5
+    # Hysteresis: consecutive bad observations before OK->WARN, consecutive
+    # severity-2 observations before ->CRIT; de-escalation costs
+    # health_warn_streak clean observations PER level.
+    health_warn_streak: int = 2
+    health_crit_streak: int = 4
+    # Live exporter (trlx_tpu/observability/export.py): process 0 serves
+    # Prometheus-text /metrics and JSON /healthz on this port while the run
+    # is alive (0 = off). TRLX_TPU_METRICS_PORT overrides.
+    metrics_port: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -1,6 +1,6 @@
-"""Unified observability layer (PR 8).
+"""Unified observability layer (PR 8) + training-health monitor (PR 9).
 
-Four parts, all off-hot-path and off by default:
+Six parts, all off-hot-path and off by default:
 
 - ``spans``     — cross-thread Chrome-trace span tracing into
                   ``<ckpt_dir>/spans.jsonl`` (``train.trace_spans`` /
@@ -12,10 +12,19 @@ Four parts, all off-hot-path and off by default:
 - ``anomaly``   — rolling-median step-time detector + one-shot incident
                   bundles under ``<ckpt_dir>/incidents/<step>/``
                   (``train.anomaly_factor`` / ``TRLX_TPU_ANOMALY_FACTOR``);
+- ``health``    — streaming RLHF health detectors (reward drift, KL
+                  controller, entropy collapse, value EV, rollout sentinels)
+                  with OK/WARN/CRIT hysteresis, ``health/*`` gauges, and
+                  per-chunk lineage records (``train.health_monitor`` /
+                  ``TRLX_TPU_HEALTH=1``);
+- ``export``    — live Prometheus-text ``/metrics`` + JSON ``/healthz``
+                  endpoint from process 0 (``train.metrics_port`` /
+                  ``TRLX_TPU_METRICS_PORT``);
 - ``report``    — ``python -m trlx_tpu.observability.report <ckpt_dir>``
                   renders everything as one markdown performance report.
 
-See RUNBOOK.md §8 for knobs and triage.
+See RUNBOOK.md §8 (performance) and §9 (training health) for knobs and
+triage.
 """
 
 import os
@@ -23,6 +32,7 @@ import os
 from trlx_tpu.observability import spans  # noqa: F401 — canonical import point
 from trlx_tpu.observability.anomaly import AnomalyDetector, IncidentCapture  # noqa: F401
 from trlx_tpu.observability.devicemon import DeviceMonitor  # noqa: F401
+from trlx_tpu.observability.health import HealthMonitor, LineageRecord  # noqa: F401
 from trlx_tpu.observability.spans import instant, trace_span  # noqa: F401
 
 

@@ -1,0 +1,155 @@
+"""Live metrics endpoint: stdlib-HTTP Prometheus ``/metrics`` + ``/healthz``.
+
+A production RLHF run needs scrapeable health signals while it is ALIVE —
+the markdown report renders after the fact, and metrics.jsonl is a file on
+one host. This exporter is a zero-dependency ``http.server`` daemon thread
+on process 0, armed by ``train.metrics_port`` (``TRLX_TPU_METRICS_PORT``
+overrides) and off by default:
+
+- ``GET /metrics``  — Prometheus text exposition (version 0.0.4) of the
+  freshest log-boundary scalars + ``health/*`` gauges. Keys are sanitized
+  (``/`` and ``-`` are illegal in metric names) and prefixed ``trlx_tpu_``;
+  keys ending ``_total`` are typed ``counter``, everything else ``gauge``.
+- ``GET /healthz`` — the HealthMonitor's JSON status
+  (``ok`` / ``degraded`` / ``critical`` + per-detector states).
+
+Multi-host: the trainer rolls the gauges up over the existing
+``allgather_host`` path (``rollup_window_stats``) BEFORE handing them over,
+so process 0 serves fleet-level ``/hostmean`` / ``/hostmax`` views, not its
+own shard's numbers.
+
+The handler reads a snapshot under a lock and never touches trainer state —
+a scrape can never stall a train step.
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["sanitize_metric_name", "MetricsExporter"]
+
+# Prometheus metric names must match [a-zA-Z_:][a-zA-Z0-9_:]* — the tracker's
+# slash-namespaced keys (health/kl_ratio, time/train_s, obs/train_mfu_pct)
+# and dash-bearing keys are all illegal until sanitized.
+_ILLEGAL = re.compile(r"[^a-zA-Z0-9_:]")
+_VALID = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def sanitize_metric_name(key: str) -> str:
+    """Map an arbitrary tracker key to a legal Prometheus metric name:
+    every illegal character (``/``, ``-``, ``.``, spaces, ...) becomes
+    ``_``, and a leading digit gets a ``_`` prefix."""
+    name = _ILLEGAL.sub("_", str(key))
+    if not name or not _VALID.match(name):
+        name = "_" + name
+    return name
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(v)
+
+
+class MetricsExporter:
+    """Threaded HTTP server publishing the latest gauge snapshot.
+
+    ``port=0`` binds an ephemeral port (tests); the trainer only constructs
+    one when the configured port is > 0. ``update()`` replaces nothing —
+    it merges, so gauges logged at different cadences (per-step stats,
+    per-window phase stats) coexist in one scrape."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0", prefix: str = "trlx_tpu_"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._gauges = {}
+        self._health = None
+        self._step = 0
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: D102 — silence per-request spam
+                pass
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = exporter.render_metrics().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = (json.dumps(exporter.render_healthz()) + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="trlx-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def update(self, gauges: dict, step=None, health=None):
+        """Merge the freshest scalar gauges (and optionally the health
+        payload for ``/healthz``). Non-numeric values are dropped here so a
+        stray string in a stats dict can never corrupt the exposition."""
+        numeric = {
+            k: float(v) for k, v in (gauges or {}).items() if isinstance(v, (int, float))
+        }
+        with self._lock:
+            self._gauges.update(numeric)
+            if step is not None:
+                self._step = int(step)
+            if health is not None:
+                self._health = health
+
+    def render_metrics(self) -> str:
+        with self._lock:
+            gauges = dict(self._gauges)
+            step = self._step
+        # Sanitized-name collisions (a/b vs a_b) keep the last writer —
+        # exposition must never emit a duplicate metric name.
+        by_name = {}
+        for key in sorted(gauges):
+            by_name[sanitize_metric_name(self.prefix + key)] = (key, gauges[key])
+        lines = []
+        for name in sorted(by_name):
+            key, value = by_name[name]
+            kind = "counter" if key.endswith("_total") else "gauge"
+            lines.append(f"# HELP {name} trlx_tpu tracker key {key!r}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {_fmt_value(value)}")
+        name = sanitize_metric_name(self.prefix + "last_step")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {step}")
+        return "\n".join(lines) + "\n"
+
+    def render_healthz(self) -> dict:
+        with self._lock:
+            health = self._health
+            step = self._step
+        payload = {"status": "unknown", "detectors": {}}
+        if health:
+            payload.update(health)
+        payload["step"] = step
+        return payload
+
+    def close(self):
+        self._server.shutdown()
+        self._thread.join(timeout=5)
+        self._server.server_close()

@@ -306,10 +306,75 @@ def build_report(checkpoint_dir: str) -> str:
         lines.append("No spans recorded (train.trace_spans off — set it or TRLX_TPU_SPANS=1).")
     lines.append("")
 
-    # --- incidents --------------------------------------------------------
-    lines += ["## Incidents", ""]
+    # --- training health --------------------------------------------------
     incidents_dir = os.path.join(checkpoint_dir, "incidents")
     bundles = sorted(os.listdir(incidents_dir)) if os.path.isdir(incidents_dir) else []
+    lines += ["## Training health", ""]
+    state_names = {0: "OK", 1: "WARN", 2: "CRIT"}
+    state_keys = sorted(
+        {k for r in scalars for k in r if k.startswith("health/") and k.endswith("_state")}
+    )
+    if state_keys:
+        lines.append("| detector | last | worst | records | trend (0=OK 1=WARN 2=CRIT) |")
+        lines.append("|---|---|---|---|---|")
+        for key in state_keys:
+            series = [float(r[key]) for r in scalars if key in r]
+            detector = key[len("health/") : -len("_state")]
+            lines.append(
+                "| {} | {} | {} | {} | `{}` |".format(
+                    detector,
+                    state_names.get(int(series[-1]), "?"),
+                    state_names.get(int(max(series)), "?"),
+                    len(series),
+                    _trend(series),
+                )
+            )
+        changes = [r["health/state_changes_total"] for r in scalars if "health/state_changes_total" in r]
+        if changes:
+            lines.append("")
+            lines.append(f"- state transitions: {int(changes[-1])} total")
+        # Cross-links: incident bundles this monitor escalated (reason
+        # health_<detector>) — the full bundle table is in ## Incidents.
+        health_bundles = []
+        for name in bundles:
+            try:
+                with open(os.path.join(incidents_dir, name, "incident.json")) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if str(manifest.get("reason", "")).startswith("health_"):
+                health_bundles.append((name, manifest.get("reason")))
+        if health_bundles:
+            lines.append(
+                "- escalated incidents: "
+                + " · ".join(f"{reason} -> `incidents/{name}/`" for name, reason in health_bundles)
+            )
+        hists = [r for r in metrics if r.get("histogram") == "health/lineage_staleness"]
+        if hists:
+            last = hists[-1]
+            lines.append(
+                "- lineage staleness (last window): " + " · ".join(
+                    f"{k} {_fmt(last.get(k))}"
+                    for k in ("count", "p5", "p50", "p95", "max")
+                    if k in last
+                )
+            )
+        lineage_path = os.path.join(checkpoint_dir, "lineage.jsonl")
+        if os.path.exists(lineage_path):
+            records = _load_jsonl(lineage_path)
+            if records:
+                stale_vals = [r.get("staleness", 0.0) for r in records]
+                lines.append(
+                    f"- lineage records: {len(records)} chunks · staleness mean "
+                    f"{_fmt(float(np.mean(stale_vals)))} max {_fmt(float(np.max(stale_vals)), 1)} "
+                    "(`lineage.jsonl`)"
+                )
+    else:
+        lines.append("No health records (train.health_monitor off — set it or TRLX_TPU_HEALTH=1).")
+    lines.append("")
+
+    # --- incidents --------------------------------------------------------
+    lines += ["## Incidents", ""]
     if bundles:
         lines.append("| step | reason | sections | bundle |")
         lines.append("|---|---|---|---|")

@@ -147,6 +147,15 @@ def ppo_loss(
         # so normalize by the true episode count instead of jnp.mean's row count.
         mean_kl = jnp.sum(log_ratio) / n_seqs
         mean_return = jnp.sum(rewards * mask) / n_seqs
+    # Health diagnostics (trlx_tpu/observability/health.py) — reductions
+    # only, the objective above is untouched: a Monte-Carlo entropy estimate
+    # over the sampled tokens (E[-log pi(a|s)] under the policy's own
+    # samples), and the value head's explained variance over the (stopped)
+    # GAE returns — negative EV means the critic is worse than predicting
+    # the mean return.
+    ret_mean = masked_mean(returns, mask)
+    ret_var = masked_mean(jnp.square(returns - ret_mean), mask)
+    err_var = masked_mean(jnp.square(returns - vpred), mask)
     stats = {
         "loss": loss,
         "pg_loss": pg_loss,
@@ -157,6 +166,8 @@ def ppo_loss(
         "mean_ratio": masked_mean(ratio, mask),
         "mean_return": mean_return,
         "mean_advantage": masked_mean(advantages, mask),
+        "mean_entropy": masked_mean(-logprobs, mask),
+        "explained_variance": 1.0 - err_var / (ret_var + 1e-8),
     }
     return loss, stats
 

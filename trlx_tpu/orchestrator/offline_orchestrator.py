@@ -83,8 +83,15 @@ class OfflineOrchestrator(Orchestrator):
                     break
 
         sample_lengths = np.asarray([len(x) for x in input_ids], dtype=np.float32)
-        print(f"[Mean reward] {np.mean(np.asarray(rewards, dtype=np.float32)):.2f}")
+        mean_reward = float(np.mean(np.asarray(rewards, dtype=np.float32)))
+        print(f"[Mean reward] {mean_reward:.2f}")
         print(f"[Mean sample length] {np.mean(sample_lengths):.2f}")
+        monitor = getattr(model, "_health", None)
+        if monitor is not None:
+            # Offline feed point: one reward-distribution observation per
+            # experience batch (the un-normalized rewards — z-scored returns
+            # would hide exactly the drift the detector watches for).
+            monitor.observe_reward(mean_reward)
 
         # z-score returns over the samples that actually train (degenerate
         # prompt-only rows would pollute the statistics while contributing

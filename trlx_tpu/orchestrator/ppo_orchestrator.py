@@ -70,6 +70,17 @@ class PPOOrchestrator(Orchestrator):
                     # Sleep well past the timeout so the hang watchdog, not
                     # luck, decides the outcome.
                     time.sleep(max(t.reward_fn_timeout, 0.1) * 3)
+                if fault_plan.fire("reward_drift", call_index):
+                    # Latch the health monitor's observed-reward offset from
+                    # this call INDEX on — training rewards stay untouched,
+                    # only the drift detector's view shifts (the stats-only
+                    # drill contract, trlx_tpu/resilience/faults.py). Keyed
+                    # by index, not wall clock: earlier calls' observations
+                    # may still be in flight on another thread and must stay
+                    # clean to seed the baseline.
+                    monitor = getattr(self.rl_model, "_health", None)
+                    if monitor is not None:
+                        monitor.inject_reward_drift(from_call=call_index)
             return self.rl_model.reward_fn(texts)
 
         return call_with_retries(
@@ -147,6 +158,32 @@ class PPOOrchestrator(Orchestrator):
             rl, "has_reward_model", False
         )
 
+        monitor = getattr(rl, "_health", None)
+        # Lineage: the weights these rollouts come from. A boundary snapshot
+        # carries the train iteration it was copied at; the serial /
+        # staleness-0 paths read the LIVE state, whose version is iter_count.
+        weight_version = iter_count
+        if isinstance(snapshot, dict):
+            weight_version = int(snapshot.get("version", iter_count))
+
+        def note_chunk(tokens_h, mask_h, P, scores, reward_call=None):
+            # Health feed for one scored chunk: reward-drift observation,
+            # degenerate-sample sentinels, lineage record. Runs on whichever
+            # thread finishes the chunk (the make_experience thread) — the
+            # monitor serializes internally. reward_call keys the drift
+            # drill's offset to this chunk's reward-call index.
+            if monitor is not None:
+                monitor.observe_chunk(
+                    tokens_h,
+                    mask_h,
+                    P,
+                    scores=scores,
+                    weight_version=weight_version,
+                    staleness=staleness,
+                    step=iter_count,
+                    reward_call=reward_call,
+                )
+
         n_collected = 0
         clock = Clock()
         # Per-phase accounting (head-to-head attribution): generate-blocked,
@@ -195,11 +232,12 @@ class PPOOrchestrator(Orchestrator):
             push_s += time.time() - t0
             span_complete("rollout/push", t0, rows=int(q_ids.shape[0]))
 
-        def finish_chunk(ctx, scores):
+        def finish_chunk(ctx, scored):
             # Device scoring + pulls + store push for one scored chunk. Runs
             # on the make_experience thread ONLY — all device dispatch stays
             # on one thread, so program order is deterministic.
             nonlocal score_s, last_scores, last_kl
+            scores, reward_call = scored
             t0 = time.time()
             if ctx["gen_aux"] is not None:
                 logprobs, values, rewards, kl = rl.rollout_score_fused(
@@ -213,6 +251,7 @@ class PPOOrchestrator(Orchestrator):
             score_s += time.time() - t0
             span_complete("rollout/score_device", t0, step=iter_count)
             push_rows(ctx["tokens_h"], ctx["mask_h"], ctx["P"], logprobs, values, rewards)
+            note_chunk(ctx["tokens_h"], ctx["mask_h"], ctx["P"], scores, reward_call)
             last_scores, last_kl = np.asarray(scores), kl
 
         def host_score(args):
@@ -233,7 +272,11 @@ class PPOOrchestrator(Orchestrator):
             with trace_span("rollout/decode", step=iter_count):
                 texts_or_tokens = rl.decode(tokens_h, mask_h)
             with trace_span("rollout/reward_fn", step=iter_count):
-                return np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+                scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+            # The call index this chunk was scored under (scoring runs
+            # sequentially on one thread, so the counter is stable here) —
+            # finish_chunk hands it to the health monitor's lineage feed.
+            return scores, self._reward_calls
 
         worker = None
         inflight = None
@@ -307,6 +350,7 @@ class PPOOrchestrator(Orchestrator):
                     score_s += time.time() - t
                     span_complete("rollout/score_rm", t, step=iter_count)
                     push_rows(tokens_h, mask_h, P, logprobs, values, rewards)
+                    note_chunk(tokens_h, mask_h, P, scores)
                     last_scores, last_kl = np.asarray(scores), kl
                 elif worker is not None:
                     # Hand decode+reward to the worker; keep the device busy.

@@ -268,6 +268,13 @@ class RolloutProducer:
         self._cv = threading.Condition()
         self._consumed = 0  # training iterations fully consumed
         self._ready = deque()  # completed stores, FIFO
+        # Per-completed-store lineage (bounded): the store's index, the
+        # staleness it was produced at, and the weight version of the
+        # snapshot it read (None when reading live state). The health
+        # monitor's per-chunk records carry the same facts per chunk; this
+        # is the producer-side summary the incident thread dumps can be
+        # cross-referenced against.
+        self.history = deque(maxlen=64)
         self._snapshot = None
         self._error = None
         self._stop = False
@@ -306,6 +313,17 @@ class RolloutProducer:
                 if self._stop:
                     return  # aborted mid-phase: the partial store is dropped
                 self._ready.append(store)
+                self.history.append(
+                    {
+                        "index": index,
+                        "staleness": staleness,
+                        "version": (
+                            snapshot.get("version")
+                            if isinstance(snapshot, dict)
+                            else None
+                        ),
+                    }
+                )
                 self._cv.notify_all()
             index += 1
 

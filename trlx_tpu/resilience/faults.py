@@ -26,7 +26,18 @@ What "tick" means is defined by the injection site:
                        log-boundary sync, inflating the measured step_time →
                        exercises the observability anomaly detector +
                        incident capture (trlx_tpu/observability/anomaly.py)
-                       on CPU.
+                       on CPU;
+- ``reward_drift@N`` — from the Nth reward call on, the chunk-mean score
+                       the health monitor OBSERVES is offset by
+                       ``TRLX_TPU_REWARD_DRIFT_DELTA`` (default 1000) —
+                       training rewards are untouched → walks the
+                       reward-drift detector's WARN→CRIT path without a
+                       real divergence (trlx_tpu/observability/health.py);
+- ``entropy_collapse@N`` — from train step N on, the sampled-token entropy
+                       the health monitor OBSERVES is scaled by
+                       ``TRLX_TPU_ENTROPY_COLLAPSE_SCALE`` (default 0.01) →
+                       walks the entropy-collapse detector's path, same
+                       stats-only contract.
 
 Multi-host kinds (fired per PROCESS — a 2-process drill sets a different
 ``TRLX_TPU_FAULTS`` on each worker; tests/test_distributed_resilience.py):
@@ -61,6 +72,8 @@ KINDS = (
     "ckpt_corrupt",
     "sigterm",
     "slow_step",
+    "reward_drift",
+    "entropy_collapse",
     "host_hang",
     "host_kill",
     "slow_host",

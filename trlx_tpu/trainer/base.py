@@ -264,18 +264,36 @@ class JaxBaseTrainer(BaseRLTrainer):
             self._anomaly = obs.AnomalyDetector(
                 anomaly_factor, window=config.train.anomaly_window
             )
-            self._incidents = obs.IncidentCapture(
-                ckpt_dir,
-                monitor=self._devicemon,
-                metrics_path=os.path.join(ckpt_dir, "metrics.jsonl"),
-                max_incidents=config.train.max_incidents,
-                profiling_active=lambda: getattr(self, "_profiling", False),
+            self._incidents = self._build_incident_capture(ckpt_dir)
+        # Training-health monitor (trlx_tpu/observability/health.py):
+        # streaming drift/collapse/sentinel detectors over the stats this
+        # trainer already logs. A CRIT transition escalates through the same
+        # emergency hook as the collective-timeout path, so arming health
+        # also arms IncidentCapture even at anomaly_factor 0.
+        self._health = None
+        if config.train.health_monitor or obs.env_flag("TRLX_TPU_HEALTH"):
+            if self._incidents is None:
+                self._incidents = self._build_incident_capture(ckpt_dir)
+            self._health = obs.HealthMonitor(
+                warmup=config.train.health_warmup,
+                warn_streak=config.train.health_warn_streak,
+                crit_streak=config.train.health_crit_streak,
+                lineage_path=(
+                    os.path.join(ckpt_dir, "lineage.jsonl") if is_main_process() else None
+                ),
             )
-            # The collective-timeout abort path runs on a timer thread with
-            # no trainer reference — register the capture for it.
-            obs.anomaly.register_emergency(
-                self._incidents, lambda: getattr(self, "iter_count", 0)
-            )
+        # Live /metrics + /healthz endpoint (trlx_tpu/observability/
+        # export.py): process 0 only, armed by the port knob. The port is
+        # recorded on EVERY process — multi-host gauge rollup needs all
+        # hosts to enter the allgather (see _export_metrics).
+        self._metrics_port = int(
+            os.environ.get("TRLX_TPU_METRICS_PORT", "") or config.train.metrics_port
+        )
+        self._metrics_exporter = None
+        if self._metrics_port > 0 and is_main_process():
+            from trlx_tpu.observability.export import MetricsExporter
+
+            self._metrics_exporter = MetricsExporter(self._metrics_port)
 
         self.reward_fn = kwargs.pop("reward_fn", None)
         self.metric_fn = kwargs.pop("metric_fn", None)
@@ -358,6 +376,44 @@ class JaxBaseTrainer(BaseRLTrainer):
         if monitor is None:
             return fn
         return monitor.wrap(name, fn, phase=phase)
+
+    def _build_incident_capture(self, ckpt_dir: str):
+        """Arm the incident machinery + the emergency hook (the collective-
+        timeout abort path and the health monitor's CRIT escalation both run
+        on threads with no trainer reference in scope)."""
+        incidents = obs.IncidentCapture(
+            ckpt_dir,
+            monitor=self._devicemon,
+            metrics_path=os.path.join(ckpt_dir, "metrics.jsonl"),
+            max_incidents=self.config.train.max_incidents,
+            profiling_active=lambda: getattr(self, "_profiling", False),
+        )
+        obs.anomaly.register_emergency(
+            incidents, lambda: getattr(self, "iter_count", 0)
+        )
+        return incidents
+
+    def _export_metrics(self, stats_host: dict):
+        """Push the freshest log-boundary scalars (health gauges included) to
+        the live /metrics endpoint. Multi-host: the scalars are rolled up
+        over the existing allgather_host path FIRST — the port knob is
+        config-consistent, so every process enters the collective and
+        process 0 serves fleet /hostmean //hostmax views, not its own
+        shard's numbers."""
+        if self._metrics_port <= 0:
+            return
+        gauges = dict(stats_host)
+        if jax.process_count() > 1:
+            from trlx_tpu.observability.report import rollup_window_stats
+
+            gauges.update(rollup_window_stats(gauges))
+        if self._metrics_exporter is not None:
+            health = getattr(self, "_health", None)
+            self._metrics_exporter.update(
+                gauges,
+                step=self.iter_count,
+                health=health.healthz() if health is not None else None,
+            )
 
     def _flush_device_telemetry(self, phase_seconds: dict) -> dict:
         """Window-boundary telemetry flush: drain the monitor's per-phase
@@ -815,6 +871,11 @@ class JaxBaseTrainer(BaseRLTrainer):
                 # Final registry persist: dispatches since the last window
                 # boundary must still show in programs.json for the report.
                 self._devicemon.flush()
+            if self._metrics_exporter is not None:
+                # Exporter last: it only serves snapshots, so scrapers get
+                # the final gauge state right up to teardown.
+                self._metrics_exporter.close()
+                self._metrics_exporter = None
             if self._profiling:
                 jax.profiler.stop_trace()
             if handler_installed:
@@ -1040,6 +1101,30 @@ class JaxBaseTrainer(BaseRLTrainer):
                                 v = np.asarray(v)
                                 stats_host[f"{k}/mean"] = float(v.mean())
                                 stats_host[f"{k}/max"] = float(v.max())
+                        if self._health is not None:
+                            # Health feed: judge the synced per-step stats,
+                            # then ride the health/* gauges along in the same
+                            # record. The entropy_collapse drill latches here
+                            # (stats-only — training never sees it).
+                            if self.fault_plan and self.fault_plan.fire(
+                                "entropy_collapse", self.iter_count
+                            ):
+                                self._health.inject_entropy_collapse()
+                            kl_ctl = getattr(self, "kl_ctl", None)
+                            self._health.observe_train(
+                                stats_host,
+                                self.iter_count,
+                                kl_coef=getattr(kl_ctl, "value", None),
+                                kl_target=getattr(kl_ctl, "target", None),
+                                kl_init_coef=getattr(
+                                    self.config.method, "init_kl_coef", None
+                                ),
+                            )
+                            stats_host.update(self._health.gauges())
+                            self._health.maybe_log_lineage(
+                                self.tracker, self.iter_count
+                            )
+                        self._export_metrics(stats_host)
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
                         self._last_log_t = time.time()

@@ -395,6 +395,10 @@ class PPOTrainer(JaxBaseTrainer):
                     if self.state.extras is None
                     else jax.tree_util.tree_map(jnp.copy, self.state.extras)
                 ),
+                # Weight-version tag for the lineage records: the train
+                # iteration these params were copied at. Pure host metadata —
+                # nothing device-side reads it.
+                "version": int(self.iter_count),
             }
             if self._qw is not None:
                 snap["qw"] = self._quantize_fn(snap["params"])
@@ -736,6 +740,12 @@ class PPOTrainer(JaxBaseTrainer):
                 }
             )
         )
+        health = getattr(self, "_health", None)
+        if health is not None:
+            # The window record carries the freshest health states too, so
+            # the per-window view (the one the report's tables read) shows
+            # detector state at rollout boundaries, not just per-step.
+            stats.update(health.gauges())
         if jax.process_count() > 1 and self._devicemon is not None:
             from trlx_tpu.observability.report import rollup_window_stats
 
