@@ -55,9 +55,11 @@ bench:
 bench-reference:
 	python bench_reference.py
 
-# CPU decode-path smoke, ~1 min: interpret-mode flash-decode parity at the
+# CPU decode-path smoke, ~2 min: interpret-mode flash-decode parity at the
 # flagship head layout + static tile legality at the full bench shape +
-# a tiny bucketed rollout (trace count <= n_buckets). Writes BENCH_SMOKE.json.
+# a tiny bucketed rollout (trace count <= n_buckets) + the decode_engine
+# probe (slot decode parity vs static batch, occupancy > 0.85, engine
+# tokens/s above the static rate). Writes BENCH_SMOKE.json.
 bench-smoke:
 	$(TEST_ENV) python bench_smoke.py
 

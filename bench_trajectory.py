@@ -49,6 +49,7 @@ def _parse_run(path: str):
     m = re.search(r"r(\d+)", path)
     entry = {"source": path, "run": int(m.group(1)) if m else None, "rc": run.get("rc")}
     parsed = run.get("parsed")
+    tail_error = None
     if not parsed:
         for line in reversed(run.get("tail", "").splitlines()):
             line = line.strip()
@@ -56,10 +57,25 @@ def _parse_run(path: str):
                 try:
                     parsed = json.loads(line)
                     break
-                except ValueError:
+                except ValueError as e:
+                    tail_error = f"{type(e).__name__}: {e}"
                     continue
     if not parsed or not isinstance(parsed.get("value"), (int, float)):
         entry["no_data"] = True
+        # Say WHY the run carries no data, so a gap in the trajectory is
+        # triageable from BENCH_TRAJECTORY.json alone: a failed run, a parsed
+        # block missing its numeric value, a metric line that would not
+        # parse, or no metric line at all. The gate below is unchanged —
+        # no_data entries were never gated.
+        rc = run.get("rc")
+        if rc not in (0, None):
+            entry["reason"] = f"bench run exited rc={rc}"
+        elif parsed:
+            entry["reason"] = "parsed metric block has no numeric 'value'"
+        elif tail_error:
+            entry["reason"] = f"metric line in tail failed to parse: {tail_error}"
+        else:
+            entry["reason"] = "no parseable metric line in artifact tail"
         return entry
     entry["metric"] = parsed.get("metric")
     entry["samples_per_sec_per_chip"] = float(parsed["value"])
@@ -84,6 +100,13 @@ def _parse_smoke(path: str):
         out["fused_loss_tokens_per_s"] = float(fused["tokens_per_s"])
     if isinstance(overlap.get("overlap_fraction_max"), (int, float)):
         out["overlap_fraction_max"] = float(overlap["overlap_fraction_max"])
+    engine = smoke.get("decode_engine", {})
+    if isinstance(engine.get("decode_tokens_per_s"), (int, float)):
+        out["engine_decode_tokens_per_s"] = float(engine["decode_tokens_per_s"])
+        if isinstance(engine.get("static_decode_tokens_per_s"), (int, float)):
+            out["static_decode_tokens_per_s"] = float(engine["static_decode_tokens_per_s"])
+        if isinstance(engine.get("slot_occupancy"), (int, float)):
+            out["engine_slot_occupancy"] = float(engine["slot_occupancy"])
     return out
 
 

@@ -92,6 +92,8 @@ def test_rollout_decode_stats():
         [[1, 1, 1, 1, 1, 0, 0], [0, 1, 1, 1, 1, 1, 1]], dtype=np.int32
     )
     s = JaxBaseTrainer.rollout_decode_stats(mask, 3)
+    episode_steps = s.pop("episode_steps")
+    assert episode_steps.tolist() == [2, 4]  # what each row USED (vs PAID: 4)
     assert s == {"gen_tokens": 6, "decode_steps": 4, "decode_step_budget": 4}
 
 

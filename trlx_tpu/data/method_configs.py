@@ -109,6 +109,29 @@ class PPOConfig(MethodConfig):
     # bucketed (B/4, B/2, 3B/4, B) to bound retraces. Off (the default)
     # keeps the unpacked per-episode-row layout byte-identical to before.
     pack_train_batch: bool = False
+    # Continuous-batching rollout engine (trlx_tpu/engine). All four knobs
+    # default to the static-batch chunked rollout path, byte-identical to
+    # before.
+    #
+    # rollout_engine: route experience generation through the slot-based
+    # engine — finished sequences free their slot immediately and a queued
+    # prompt is prefilled into it, so mixed response lengths stop paying the
+    # whole-chunk straggler cost. Single-host; requires no soft prompts and
+    # no decode_weight_quant (the engine scores unfused — see PPOTrainer's
+    # validation).
+    rollout_engine: bool = False
+    # engine_slots: size of the engine's fixed slot pool (the compiled decode
+    # program's batch dimension). 0 = auto: chunk_size.
+    engine_slots: int = 0
+    # prefill_batch: slot admission batches prompt prefills — while slots are
+    # live, admission waits until this many slots are free, then prefills one
+    # same-width group in a single compiled call.
+    prefill_batch: int = 4
+    # engine_steps_per_sync: decode steps the engine runs per host
+    # round-trip. Larger values amortize dispatch/sync overhead; finished
+    # slots sit idle for at most this many steps before harvest+refill (the
+    # occupancy cost of the amortization).
+    engine_steps_per_sync: int = 8
 
 
 @dataclass

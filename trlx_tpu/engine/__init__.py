@@ -1,0 +1,9 @@
+"""Continuous-batching rollout engine (slot-based decode over the KV cache).
+
+See rollout_engine.RolloutEngine — the `submit(prompts) -> stream of finished
+episodes` boundary ppo_orchestrator.make_experience and the RolloutProducer
+consume when ``method.rollout_engine`` is on."""
+
+from trlx_tpu.engine.rollout_engine import Episode, RolloutEngine
+
+__all__ = ["Episode", "RolloutEngine"]

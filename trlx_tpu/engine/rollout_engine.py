@@ -1,0 +1,541 @@
+"""Slot-based continuous-batching rollout engine.
+
+Static-batch decode (ops/generate.py) pays for the SLOWEST sequence in every
+chunk: all rows step together until the last one finishes, so mixed response
+lengths leave most of the batch idle — the straggler cost the serving-style
+continuous-batching loop (PipelineRL, arxiv 2509.19128) removes. This module
+is that loop for the rollout side of PPO:
+
+- A fixed pool of ``n_slots`` decode slots shares ONE KV cache pytree
+  ([n_slots, cache_len, ...], int8 when kv_cache_quant) and ONE compiled
+  ``decode_step`` program. Per-slot lengths are pure data: every slot carries
+  its own write offset (``write_pos``) and cache-validity row, the model's
+  vector ``cache_index`` path scatters each slot's KV at its own offset, and
+  the attention bias/flash-decode kernel already handle ragged cache lengths
+  per row (ops/tiling.slot_decode_layout is the layout contract).
+- A host-side slot manager admits prompts from a width-grouped queue
+  (pipeline.PromptSlotQueue — PR 4's bucketing becomes slot admission) into
+  free slots via a batched, jitted prefill (one compiled program per
+  (group size, bucket width)), and harvests finished slots every
+  ``steps_per_sync`` decode steps.
+- Weights are handed over EXPLICITLY and versioned (``update_weights``) via
+  the trainer's snapshot/re-quantize path — the engine never reads live
+  (donated) train state. The dispatch lock is held exactly at the engine's
+  own dispatch sites.
+
+Parity contract: with greedy sampling the engine's per-slot decode is
+token-for-token identical to whole-batch ``generate`` (same write-mask-
+before-apply ordering, same position derivation, EOS written with its mask
+bit set, post-finish positions pad/mask-0). Sampled decode draws from a
+single per-step key shared across slots — statistically equivalent but not
+bitwise equal to the chunked path, which is why the trainer only routes
+PPO's default sampled rollouts through the engine when asked
+(``method.rollout_engine``).
+"""
+
+import time
+from contextlib import nullcontext
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.lm import init_cache
+from trlx_tpu.observability.spans import trace_span
+from trlx_tpu.ops.sampling import GenerateConfig, process_logits_default
+from trlx_tpu.pipeline.prompt_pipeline import PromptSlotQueue
+
+
+@dataclass
+class Episode:
+    """One finished rollout episode, as host arrays.
+
+    ``prompt_ids``/``prompt_mask`` are the bucket-width left-padded rows as
+    submitted; ``response_ids``/``response_mask`` are right-padded to the
+    max_new_tokens budget with EXACTLY the whole-batch ``generate``
+    convention (EOS token mask-1, post-finish positions pad/mask-0).
+    ``decode_steps`` is the per-episode decode step count — free from the
+    slot length, no mask arithmetic needed."""
+
+    prompt_ids: np.ndarray
+    prompt_mask: np.ndarray
+    response_ids: np.ndarray
+    response_mask: np.ndarray
+    decode_steps: int
+    weight_version: Optional[int] = None
+
+
+class RolloutEngine:
+    """Continuous-batching decode over a fixed slot pool.
+
+    Protocol (the orchestrator is the first client):
+
+        engine.update_weights(variables, version=it)   # explicit handoff
+        engine.submit(prompt_ids, prompt_mask)         # any bucket width
+        while collecting:
+            episodes = engine.step()                   # admit → decode → harvest
+
+    ``step()`` runs ``steps_per_sync`` decode steps per device round-trip
+    (amortizing the host sync), refills finished slots from the queue
+    (batched prefill once ≥ ``prefill_batch`` slots are free — or
+    unconditionally when nothing is live, so admission can never deadlock),
+    and returns finished episodes in completion order.
+    """
+
+    def __init__(
+        self,
+        model,
+        gen_cfg: GenerateConfig,
+        *,
+        n_slots: int,
+        prompt_width: int,
+        processor: Optional[Callable] = None,
+        prefill_batch: int = 4,
+        steps_per_sync: int = 8,
+        dispatch_lock=None,
+        monitor=None,
+        rng=None,
+    ):
+        if model.cfg.n_soft_tokens > 0:
+            raise ValueError(
+                "the continuous-batching engine does not support soft prompts "
+                "yet (per-slot prefill would need to replay the soft prefix "
+                "per admission); use the chunked rollout path"
+            )
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.model = model
+        self.gcfg = gen_cfg
+        self.processor = processor
+        self.n_slots = int(n_slots)
+        self.prompt_width = int(prompt_width)
+        self.cache_len = self.prompt_width + int(gen_cfg.max_new_tokens)
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.steps_per_sync = max(1, int(steps_per_sync))
+        self._lock = dispatch_lock
+        self.queue = PromptSlotQueue()
+        self._slot_meta = [None] * self.n_slots  # per-occupied-slot host facts
+        self._free = list(range(self.n_slots))
+        self._variables = None
+        self.weight_version = None
+        self._state = None
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        # Trace counters bump INSIDE the traced bodies (the make_generate_fn
+        # idiom), so they count novel shapes only: decode must stay at 1 for
+        # the life of the engine — that is the one-compiled-program contract.
+        self._traces = {"decode": 0, "prefill": 0}
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
+        if monitor is not None:
+            self._decode = monitor.wrap(
+                "engine/decode_step", self._decode, phase="rollout"
+            )
+        self._reset_counters()
+
+    # ------------------------------------------------------------- host side
+
+    def _reset_counters(self):
+        self._decode_calls = 0
+        self._decode_steps = 0
+        self._slot_steps = 0
+        self._live_row_steps = 0
+        self._refills = 0
+        self._prefill_calls = 0
+        self._completed = 0
+        self._decode_wall = 0.0
+        self._prefill_wall = 0.0
+
+    def _dispatch(self):
+        return self._lock if self._lock is not None else nullcontext()
+
+    @property
+    def num_decode_traces(self) -> int:
+        return self._traces["decode"]
+
+    @property
+    def num_prefill_traces(self) -> int:
+        return self._traces["prefill"]
+
+    @property
+    def live_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def idle(self) -> bool:
+        """Nothing queued and nothing in flight."""
+        return self.live_slots == 0 and len(self.queue) == 0
+
+    @property
+    def pending(self) -> int:
+        """Episodes still owed: queued + in-flight."""
+        return self.live_slots + len(self.queue)
+
+    def update_weights(self, variables, version=None):
+        """Explicit versioned weight handoff: ``variables`` is the decode
+        variable dict (params [+ int8 qw]) from the trainer's snapshot /
+        re-quantize path — a stable copy, never the live donated state."""
+        self._variables = variables
+        self.weight_version = version
+
+    def submit(self, input_ids, attention_mask) -> int:
+        """Queue left-padded prompts ([n, width] or [width]) for decode."""
+        ids = np.asarray(input_ids, dtype=np.int32)
+        msk = np.asarray(attention_mask, dtype=np.int32)
+        if ids.ndim == 1:
+            ids, msk = ids[None], msk[None]
+        if ids.shape[1] > self.prompt_width:
+            raise ValueError(
+                f"prompt width {ids.shape[1]} exceeds the engine's "
+                f"prompt_width {self.prompt_width}"
+            )
+        return self.queue.push_rows(ids, msk)
+
+    def step(self):
+        """One sync quantum: admit queued prompts into free slots, advance
+        every live slot ``steps_per_sync`` tokens in the single compiled
+        decode program, harvest finished slots. Returns list[Episode]."""
+        if self._variables is None:
+            raise RuntimeError(
+                "RolloutEngine.update_weights() must be called before step()"
+            )
+        self._ensure_state()
+        self._admit()
+        n_live = self.live_slots
+        if n_live == 0:
+            return []
+        t0 = time.time()
+        with trace_span("engine/decode", slots=n_live, steps=self.steps_per_sync):
+            with self._dispatch():
+                self._state, live_steps = self._decode(self._variables, self._state)
+        finished, n_gen, live_steps = jax.device_get(
+            (self._state["finished"], self._state["n_gen"], live_steps)
+        )
+        self._decode_wall += time.time() - t0
+        self._decode_calls += 1
+        self._decode_steps += self.steps_per_sync
+        self._slot_steps += self.steps_per_sync * self.n_slots
+        self._live_row_steps += int(live_steps)
+
+        episodes = []
+        done = [
+            i
+            for i in range(self.n_slots)
+            if self._slot_meta[i] is not None and bool(finished[i])
+        ]
+        if done:
+            toks = np.asarray(jax.device_get(self._state["tokens"]), dtype=np.int32)
+            R = int(self.gcfg.max_new_tokens)
+            for i in done:
+                meta, self._slot_meta[i] = self._slot_meta[i], None
+                steps = int(n_gen[i])
+                rmask = np.zeros((R,), dtype=np.int32)
+                rmask[:steps] = 1
+                episodes.append(
+                    Episode(
+                        prompt_ids=meta["prompt_ids"],
+                        prompt_mask=meta["prompt_mask"],
+                        response_ids=toks[i],
+                        response_mask=rmask,
+                        decode_steps=steps,
+                        weight_version=meta["version"],
+                    )
+                )
+                self._free.append(i)
+            self._completed += len(done)
+        return episodes
+
+    def _admit(self) -> int:
+        """Refill free slots from the queue. Prefill is BATCHED: while any
+        slot is still live, admission waits until ≥ prefill_batch slots are
+        free (or the whole queue fits in fewer) so each prefill dispatch
+        carries a full same-width group; with no live slots it admits
+        unconditionally — an empty pool must never wait on itself."""
+        admitted = 0
+        while self._free and len(self.queue):
+            want = min(self.prefill_batch, len(self.queue))
+            if len(self._free) < want and self.live_slots > 0:
+                break
+            group = self.queue.pop_group(min(len(self._free), self.prefill_batch))
+            if group is None:
+                break
+            width, ids, msk = group
+            slots = np.asarray(
+                [self._free.pop() for _ in range(ids.shape[0])], dtype=np.int32
+            )
+            t0 = time.time()
+            with trace_span("engine/prefill", n=int(ids.shape[0]), width=int(width)):
+                with self._dispatch():
+                    self._state = self._prefill(
+                        self._variables,
+                        self._state,
+                        jnp.asarray(ids),
+                        jnp.asarray(msk),
+                        jnp.asarray(slots),
+                    )
+            self._prefill_wall += time.time() - t0
+            for row, slot in enumerate(slots):
+                self._slot_meta[int(slot)] = {
+                    "prompt_ids": ids[row],
+                    "prompt_mask": msk[row],
+                    "version": self.weight_version,
+                }
+            self._prefill_calls += 1
+            self._refills += int(ids.shape[0])
+            admitted += int(ids.shape[0])
+        return admitted
+
+    def stats(self, reset: bool = True) -> dict:
+        """Window gauges: slot occupancy (live-slot decode steps over total
+        slot-steps paid), refill counters, and the engine-side decode rate."""
+        out = {
+            "engine/slot_occupancy": self._live_row_steps / max(1, self._slot_steps),
+            "engine/decode_steps": self._decode_steps,
+            "engine/decode_calls": self._decode_calls,
+            "engine/gen_tokens": self._live_row_steps,
+            "engine/refills": self._refills,
+            "engine/prefill_batches": self._prefill_calls,
+            "engine/completed": self._completed,
+            "engine/queue_depth": len(self.queue),
+            "engine/free_slots": len(self._free),
+            "engine/decode_wall_s": self._decode_wall,
+            "engine/prefill_wall_s": self._prefill_wall,
+            "engine/decode_tokens_per_s": self._live_row_steps
+            / max(self._decode_wall, 1e-9),
+        }
+        if reset:
+            self._reset_counters()
+        return out
+
+    def abort(self):
+        """Drop queued prompts and in-flight slots (phase abort on a stop
+        request). Device buffers are kept for the next phase; all slots are
+        deactivated so a subsequent decode has no live rows."""
+        self.queue.clear()
+        self._slot_meta = [None] * self.n_slots
+        self._free = list(range(self.n_slots))
+        if self._state is not None:
+            self._state = dict(
+                self._state, active=jnp.zeros((self.n_slots,), dtype=bool)
+            )
+
+    def shutdown(self):
+        """Release everything: queue, slot bookkeeping, device state, and the
+        weight reference (learn()'s finally — mirrors the producer teardown).
+        The engine owns no threads, so shutdown is synchronous and
+        idempotent."""
+        self.abort()
+        self._state = None
+        self._variables = None
+
+    # ----------------------------------------------------------- device side
+
+    def _ensure_state(self):
+        if self._state is not None:
+            return
+        cfg = self.model.cfg
+        S, T, R = self.n_slots, self.cache_len, int(self.gcfg.max_new_tokens)
+        cache = self._pin_cache(init_cache(cfg, S, T))
+        self._state = {
+            "cache": cache,
+            "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
+            "write_pos": jnp.zeros((S,), dtype=jnp.int32),
+            "n_gen": jnp.zeros((S,), dtype=jnp.int32),
+            "tokens": jnp.full((S, R), self.gcfg.pad_token_id, dtype=jnp.int32),
+            "active": jnp.zeros((S,), dtype=bool),
+            "finished": jnp.zeros((S,), dtype=bool),
+            "last_token": jnp.zeros((S,), dtype=jnp.int32),
+            "last_logits": jnp.zeros((S, cfg.vocab_size), dtype=jnp.float32),
+            "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
+            "rng": self._rng,
+        }
+
+    def _pin_cache(self, cache):
+        # Same layout pin as ops/generate.py: slots over the data axes, heads
+        # over tp — skipped when the shapes don't divide the mesh.
+        from trlx_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.peek_mesh()
+        if mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+        cfg = self.model.cfg
+        data = int(mesh.shape[mesh_mod.AXIS_DP]) * int(mesh.shape[mesh_mod.AXIS_FSDP])
+        tp = int(mesh.shape[mesh_mod.AXIS_TP])
+        if self.n_slots % data == 0 and cfg.n_head % tp == 0:
+            spec4 = NamedSharding(
+                mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP, None)
+            )
+            spec3 = NamedSharding(mesh, PSpec(mesh_mod.DATA_AXES, None, mesh_mod.AXIS_TP))
+            cache = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, spec4 if x.ndim == 4 else spec3
+                ),
+                cache,
+            )
+        elif mesh.size > 1:
+            import warnings
+
+            warnings.warn(
+                f"engine KV cache left to XLA propagation: n_slots "
+                f"{self.n_slots} or n_head {cfg.n_head} does not divide the "
+                f"mesh (data={data}, tp={tp})"
+            )
+        return cache
+
+    def _prefill_fn(self, variables, state, prompt_ids, prompt_mask, slot_ids):
+        """Batched prefill of a same-width prompt group into its slots.
+
+        Runs the group through a MINI cache at bucket width (flash-eligible:
+        static zero write offset), then scatters the per-layer KV leaves into
+        the big slot cache at [slot_ids, :width] and resets every per-slot
+        column for the admitted rows. Compiled once per (group size, width);
+        ``state`` is donated."""
+        self._traces["prefill"] += 1  # traced-body bump: novel shapes only
+        cfg = self.model.cfg
+        j, Pb = prompt_ids.shape
+        T = self.cache_len
+        R = int(self.gcfg.max_new_tokens)
+        pm = prompt_mask.astype(jnp.int32)
+        out = self.model.apply(
+            variables,
+            input_ids=prompt_ids,
+            attention_mask=pm,
+            cache=init_cache(cfg, j, Pb),
+            cache_index=0,
+            cache_mask=pm,
+            logits_start=Pb - 1,
+        )
+        new_cache = tuple(
+            tuple(
+                big.at[slot_ids, :Pb].set(mini.astype(big.dtype))
+                for big, mini in zip(big_layer, mini_layer)
+            )
+            for big_layer, mini_layer in zip(state["cache"], out["cache"])
+        )
+        row_mask = (
+            jnp.zeros((j, T), dtype=state["cache_mask"].dtype).at[:, :Pb].set(pm)
+        )
+        s = dict(state)
+        s["cache"] = new_cache
+        s["cache_mask"] = state["cache_mask"].at[slot_ids].set(row_mask)
+        s["write_pos"] = state["write_pos"].at[slot_ids].set(Pb)
+        s["n_gen"] = state["n_gen"].at[slot_ids].set(0)
+        s["active"] = state["active"].at[slot_ids].set(True)
+        s["finished"] = state["finished"].at[slot_ids].set(False)
+        s["tokens"] = (
+            state["tokens"]
+            .at[slot_ids]
+            .set(jnp.full((j, R), self.gcfg.pad_token_id, dtype=state["tokens"].dtype))
+        )
+        s["last_logits"] = (
+            state["last_logits"].at[slot_ids].set(out["logits"][:, -1].astype(jnp.float32))
+        )
+        s["last_hidden"] = (
+            state["last_hidden"]
+            .at[slot_ids]
+            .set(out["hidden"][:, -1].astype(state["last_hidden"].dtype))
+        )
+        s["last_token"] = (
+            state["last_token"].at[slot_ids].set(prompt_ids[:, -1].astype(jnp.int32))
+        )
+        return s
+
+    def _decode_fn(self, variables, state):
+        """``steps_per_sync`` decode steps for ALL slots in one program.
+
+        Mirrors ops/generate.py's loop invariants per live slot: the new
+        token's cache-mask bit is written BEFORE model.apply (the token
+        attends to itself), EOS is written with mask-1, finished/free slots
+        write nothing visible (their buffer writes are value-preserving and
+        their clamped cache write lands on a mask-0 position). Returns the
+        new state and the number of live-slot steps executed (the occupancy
+        numerator). ``state`` is donated."""
+        self._traces["decode"] += 1  # traced-body bump: must stay at 1
+        gcfg = self.gcfg
+        S, T = self.n_slots, self.cache_len
+        R = int(gcfg.max_new_tokens)
+        pad = jnp.asarray(gcfg.pad_token_id, dtype=jnp.int32)
+
+        def write_col(grid, vals, ixs):
+            # Per-row scatter of one value at each row's own column.
+            return jax.vmap(
+                lambda row, v, i: jax.lax.dynamic_update_slice(row, v[None], (i,))
+            )(grid, vals, ixs)
+
+        def one_step(carry, _):
+            s, live_steps = carry
+            live = s["active"] & ~s["finished"]
+            step_col = s["n_gen"][:, None]  # [S, 1]: per-slot decode step
+            if self.processor is not None:
+                logits = self.processor(
+                    s["last_logits"],
+                    {
+                        "last_token": s["last_token"],
+                        "hidden": s["last_hidden"],
+                        "step": step_col,
+                        "carry": {},
+                    },
+                )
+            else:
+                logits = process_logits_default(s["last_logits"], gcfg, step_col)
+            rng, sub = jax.random.split(s["rng"])
+            if gcfg.do_sample:
+                tok = jax.random.categorical(sub, logits, axis=-1)
+            else:
+                tok = jnp.argmax(logits, axis=-1)
+            tok = jnp.where(live, tok.astype(jnp.int32), pad)
+
+            # Token buffer write at (slot, n_gen), value-preserving for
+            # non-live slots (a clamped index must not clobber real tokens).
+            w_ix = jnp.minimum(s["n_gen"], R - 1)
+            cur_tok = jnp.take_along_axis(s["tokens"], w_ix[:, None], axis=1)[:, 0]
+            tokens = write_col(s["tokens"], jnp.where(live, tok, cur_tok), w_ix)
+
+            # Cache-mask bit at each live slot's write offset — BEFORE apply.
+            c_ix = jnp.minimum(s["write_pos"], T - 1)
+            cur_bit = jnp.take_along_axis(s["cache_mask"], c_ix[:, None], axis=1)[:, 0]
+            bit = jnp.where(live, jnp.ones_like(cur_bit), cur_bit)
+            cache_mask = write_col(s["cache_mask"], bit, c_ix)
+
+            if gcfg.eos_token_id is not None:
+                hit_eos = tok == gcfg.eos_token_id
+            else:
+                hit_eos = jnp.zeros_like(live)
+            finished = s["finished"] | (live & (hit_eos | (s["n_gen"] + 1 >= R)))
+
+            out = self.model.apply(
+                variables,
+                input_ids=tok[:, None],
+                attention_mask=jnp.ones((S, 1), dtype=jnp.int32),
+                cache=s["cache"],
+                cache_index=c_ix,  # [S] vector: per-slot write offsets
+                cache_mask=cache_mask,
+                prepend_soft=False,
+            )
+            live_i = live.astype(jnp.int32)
+            new_s = {
+                "cache": out["cache"],
+                "cache_mask": cache_mask,
+                "write_pos": s["write_pos"] + live_i,
+                "n_gen": s["n_gen"] + live_i,
+                "tokens": tokens,
+                "active": s["active"],
+                "finished": finished,
+                "last_token": jnp.where(live, tok, s["last_token"]),
+                "last_logits": out["logits"][:, 0].astype(jnp.float32),
+                "last_hidden": out["hidden"][:, 0].astype(s["last_hidden"].dtype),
+                "rng": rng,
+            }
+            return (new_s, live_steps + live_i.sum()), None
+
+        (state, live_steps), _ = jax.lax.scan(
+            one_step,
+            (state, jnp.zeros((), dtype=jnp.int32)),
+            None,
+            length=self.steps_per_sync,
+        )
+        return state, live_steps

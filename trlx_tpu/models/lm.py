@@ -375,6 +375,29 @@ class Attention(nn.Module):
             )
 
             single_step = q_len == 1 and attn_bias is not None
+            vector_index = (
+                cache_index is not None
+                and not isinstance(cache_index, (int, np.integer))
+                and jnp.ndim(cache_index) == 1
+            )
+            if vector_index and q_len != 1:
+                raise ValueError(
+                    "per-row cache_index ([b] vector) requires single-token "
+                    f"decode steps, got q_len={q_len}"
+                )
+
+            def cache_write(buf, upd):
+                # Scalar offset: one dynamic_update_slice covers the batch.
+                # Vector offset [b] (slot decode): every row writes at its own
+                # slot length — a vmap'd per-row update (lowers to scatter).
+                upd = upd.astype(buf.dtype)
+                if vector_index:
+                    zeros = (0,) * (buf.ndim - 2)
+                    return jax.vmap(
+                        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i,) + zeros)
+                    )(buf, upd, cache_index)
+                start = (0, cache_index) + (0,) * (buf.ndim - 2)
+                return jax.lax.dynamic_update_slice(buf, upd, start)
 
             def kernel_ok(quant):
                 # Two gates, both static at trace time: the cheap eligibility
@@ -396,10 +419,10 @@ class Attention(nn.Module):
                 k_cache, v_cache, ks_cache, vs_cache = cache
                 kq, ks = quantize_kv(k)
                 vq, vs = quantize_kv(v)
-                k_cache = jax.lax.dynamic_update_slice(k_cache, kq, (0, cache_index, 0, 0))
-                v_cache = jax.lax.dynamic_update_slice(v_cache, vq, (0, cache_index, 0, 0))
-                ks_cache = jax.lax.dynamic_update_slice(ks_cache, ks, (0, cache_index, 0))
-                vs_cache = jax.lax.dynamic_update_slice(vs_cache, vs, (0, cache_index, 0))
+                k_cache = cache_write(k_cache, kq)
+                v_cache = cache_write(v_cache, vq)
+                ks_cache = cache_write(ks_cache, ks)
+                vs_cache = cache_write(vs_cache, vs)
                 new_cache = (k_cache, v_cache, ks_cache, vs_cache)
                 if flash_mask is None:
                     if single_step and kernel_ok(True):
@@ -413,8 +436,8 @@ class Attention(nn.Module):
                         v = v_cache.astype(dtype) * vs_cache[..., None].astype(dtype)
             else:
                 k_cache, v_cache = cache
-                k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0))
-                v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0))
+                k_cache = cache_write(k_cache, k)
+                v_cache = cache_write(v_cache, v)
                 new_cache = (k_cache, v_cache)
                 # Flash prefill attends over the LOCAL block only (cache
                 # slots beyond the prompt are invalid until decode) — k/v
@@ -528,12 +551,24 @@ def make_attn_bias(
     sequences packed into one row cannot attend across each other.
     """
     kv_len = attn_mask_kv.shape[-1]
-    q_idx = q_offset + jnp.arange(q_len)[:, None]
-    k_idx = jnp.arange(kv_len)[None, :]
-    causal = k_idx <= q_idx
-    if window > 0:
-        causal = causal & (k_idx > q_idx - window)
-    valid = attn_mask_kv[:, None, None, :].astype(bool) & causal[None, None, :, :]
+    if jnp.ndim(q_offset) == 1:
+        # Per-row write offsets (slot decode): q_offset [b] gives every row
+        # its own causal frontier, so one compiled program serves slots at
+        # mixed sequence lengths. causal is [b, 1, q_len, kv_len].
+        q_idx = q_offset[:, None, None] + jnp.arange(q_len)[None, :, None]
+        k_idx = jnp.arange(kv_len)[None, None, :]
+        causal = k_idx <= q_idx
+        if window > 0:
+            causal = causal & (k_idx > q_idx - window)
+        causal = causal[:, None, :, :]
+    else:
+        q_idx = q_offset + jnp.arange(q_len)[:, None]
+        k_idx = jnp.arange(kv_len)[None, :]
+        causal = k_idx <= q_idx
+        if window > 0:
+            causal = causal & (k_idx > q_idx - window)
+        causal = causal[None, None, :, :]
+    valid = attn_mask_kv[:, None, None, :].astype(bool) & causal
     if segment_ids is not None:
         same_seg = segment_ids[:, None, None, :] == segment_ids[:, None, :, None]
         valid = valid & same_seg
@@ -649,7 +684,14 @@ class TransformerLM(nn.Module):
                 # occupancy mask (which already includes the query slots),
                 # sliced at the write offset — NOT from the 1-token query mask.
                 full_pos = jnp.maximum(jnp.cumsum(cache_mask, axis=-1) - 1, 0)
-                position_ids = jax.lax.dynamic_slice_in_dim(full_pos, cache_index, q_len, axis=1)
+                if jnp.ndim(cache_index) == 1:
+                    # Per-row write offsets (slot decode, q_len == 1): each
+                    # row reads the position at its own offset.
+                    position_ids = jnp.take_along_axis(
+                        full_pos, cache_index.astype(jnp.int32)[:, None], axis=1
+                    )
+                else:
+                    position_ids = jax.lax.dynamic_slice_in_dim(full_pos, cache_index, q_len, axis=1)
             else:
                 # Left-pad aware positions: cumsum over valid tokens
                 # (reference: trlx/model/accelerate_ppo_model.py:110-112).

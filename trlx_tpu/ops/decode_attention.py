@@ -347,3 +347,20 @@ def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale,
             **common,
         )(q, k_cache, v_cache, bias3)
     return out[:, None]  # [B, 1, h, d]
+
+
+def slot_decode_attention(q, k_cache, v_cache, ks, vs, slot_mask, *, scale,
+                          interpret=None, block_t=None):
+    """Slot-aware decode-attention entry for the continuous-batching engine.
+
+    Identical kernel and block layouts as ``decode_attention`` (see
+    tiling.slot_decode_layout) — the batch axis is the slot axis, and the
+    per-slot cache-validity mask ``slot_mask`` [S, T] (1 = valid key slot,
+    covering each slot's own ragged length) is turned into the additive bias
+    row the kernel consumes. One compiled program therefore serves every mix
+    of live slot lengths; per-slot raggedness is pure data."""
+    bias_row = jnp.where(slot_mask.astype(bool), 0.0, -1e9).astype(jnp.float32)
+    return decode_attention(
+        q, k_cache, v_cache, ks, vs, bias_row,
+        scale=scale, interpret=interpret, block_t=block_t,
+    )

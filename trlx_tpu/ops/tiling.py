@@ -126,6 +126,19 @@ def decode_block_layout(
     return layouts
 
 
+def slot_decode_layout(
+    n_slots: int, T: int, h: int, d: int, quant: bool, block_t: Optional[int] = None
+) -> list:
+    """Block layouts of the slot-based continuous-batching decode step
+    (trlx_tpu.engine): identical to ``decode_block_layout`` with the batch
+    axis reinterpreted as the fixed slot axis. This is the one-compiled-
+    program contract — the kernel's masked tail block plus the per-slot bias
+    row already handle RAGGED cache lengths, so slots at mixed sequence
+    lengths share one decode program; only (n_slots, T, h, d, quant) are
+    shape keys, per-slot lengths are data."""
+    return decode_block_layout(n_slots, T, h, d, quant, block_t=block_t)
+
+
 def flash_block_layout(BH: int, T: int, D: int, bq: int, bk: int) -> list:
     """The flash-attention forward kernel's block layouts (see
     trlx_tpu.ops.flash_attention._fwd)."""

@@ -151,6 +151,18 @@ class PPOOrchestrator(Orchestrator):
         Rows are pushed as whole chunks into the native column store
         (trlx_tpu/native/collate.cpp) — no per-sample Python objects."""
         rl = self.rl_model
+        if getattr(rl, "rollout_engine_enabled", False):
+            # Continuous-batching path (method.rollout_engine): the slot
+            # engine streams finished episodes; everything downstream of
+            # generation (reward → device scoring → store push) is shared.
+            return self._make_experience_engine(
+                num_rollouts=num_rollouts,
+                iter_count=iter_count,
+                store=store,
+                snapshot=snapshot,
+                staleness=staleness,
+                stop=stop,
+            )
         store = store if store is not None else rl.store
         record_staleness = bool(getattr(store, "record_staleness", False))
         timer = getattr(rl, "_phase_timer", None)
@@ -193,6 +205,7 @@ class PPOOrchestrator(Orchestrator):
         gen_s = reward_s = score_s = push_s = 0.0
         gen_tokens = 0
         decode_steps = []
+        episode_steps = []
         step_budget = 0
         # Final-chunk stats for logging; placeholders are never logged (the
         # aborted path returns before the tracker call).
@@ -332,6 +345,7 @@ class PPOOrchestrator(Orchestrator):
                 ds = rl.rollout_decode_stats(mask_h, P)
                 gen_tokens += ds["gen_tokens"]
                 decode_steps.append(ds["decode_steps"])
+                episode_steps.extend(int(v) for v in ds["episode_steps"])
                 step_budget = ds["decode_step_budget"]
 
                 if getattr(rl, "has_reward_model", False):
@@ -423,6 +437,12 @@ class PPOOrchestrator(Orchestrator):
             "exp_decode_tokens_per_s": gen_tokens / max(gen_s, 1e-9),
             "exp_decode_steps": float(np.mean(decode_steps)),
             "exp_decode_step_budget": float(step_budget),
+            # Per-EPISODE decode steps vs the per-chunk max above: their gap
+            # is the straggler overhead the static batch pays (see
+            # rollout_decode_stats; the engine path logs the same key).
+            "exp_decode_steps_per_episode": (
+                float(np.mean(episode_steps)) if episode_steps else 0.0
+            ),
             "rollout_mean_score": float(np.mean(last_scores)),
             "rollout_mean_kl": float(np.mean(np.asarray(last_kl).sum(-1))),
             "exp_per_sec": num_rollouts / max(exp_time, 1e-9),
@@ -430,5 +450,256 @@ class PPOOrchestrator(Orchestrator):
         if record_staleness:
             stats["exp_staleness"] = float(staleness)
         # Surfaced by progress_line at the next log boundary.
+        rl._last_exp_stats = {"exp_per_sec": stats["exp_per_sec"]}
+        rl.tracker.log(stats, step=iter_count)
+
+    def _make_experience_engine(
+        self,
+        num_rollouts: int,
+        iter_count: int,
+        store=None,
+        snapshot=None,
+        staleness: int = 0,
+        stop=None,
+    ):
+        """Continuous-batching experience generation (method.rollout_engine).
+
+        The slot engine replaces chunk-wise generate: all ``num_rollouts``
+        prompts are submitted up front, the engine streams finished episodes
+        back in COMPLETION order (short responses free their slot early and a
+        queued prompt refills it), and episodes are re-assembled into
+        chunk_size batches at the trainer's full prompt width for the SAME
+        downstream pipeline as the chunked path — host decode + reward_fn
+        (optionally on the ScoreWorker thread), unfused device scoring, store
+        push, health feed. The phase drains fully before returning: no episode
+        crosses a phase boundary, so every stored row's lineage is this
+        phase's weight handoff (explicit `update_weights`, never the live
+        donated TrainState)."""
+        rl = self.rl_model
+        store = store if store is not None else rl.store
+        record_staleness = bool(getattr(store, "record_staleness", False))
+        timer = getattr(rl, "_phase_timer", None)
+        use_worker = bool(getattr(rl, "overlap_rollouts", False))
+        monitor = getattr(rl, "_health", None)
+        heartbeat = getattr(rl, "heartbeat", None)
+        weight_version = iter_count
+        if isinstance(snapshot, dict):
+            weight_version = int(snapshot.get("version", iter_count))
+
+        # Versioned weight handoff: re-resolve (and re-quantize, when the KV
+        # path is int8) the decode variables once per phase. The engine holds
+        # its own reference — training may donate the TrainState underneath.
+        engine = rl.rollout_engine()
+        engine.update_weights(rl.rollout_engine_variables(snapshot), version=weight_version)
+
+        P_full = int(rl.prompt_length)
+        R = int(rl.response_length)
+        pad_id = int(getattr(rl, "pad_token_id", 0))
+        chunk = max(1, min(int(self.chunk_size), int(num_rollouts)))
+
+        # Submit EXACTLY num_rollouts prompts — the engine's queue empties as
+        # the phase drains, so the next phase starts from a clean engine.
+        submitted = 0
+        while submitted < num_rollouts:
+            try:
+                batch = next(self.pipeline_iterator)
+            except StopIteration:
+                self.pipeline_iterator = iter(self.pipeline_loader)
+                batch = next(self.pipeline_iterator)
+            ids = np.asarray(batch["input_ids"])
+            msk = np.asarray(batch["attention_mask"])
+            take = min(int(ids.shape[0]), num_rollouts - submitted)
+            engine.submit(ids[:take], msk[:take])
+            submitted += take
+
+        n_collected = 0
+        clock = Clock()
+        gen_s = reward_s = score_s = push_s = 0.0
+        episode_steps = []
+        last_scores = np.zeros((1,), dtype=np.float32)
+        last_kl = np.zeros((1, 1), dtype=np.float32)
+
+        def push_rows(tokens_h, mask_h, logprobs, values, rewards):
+            # Episodes are assembled at P_full already — no re-padding.
+            nonlocal push_s
+            t0 = time.time()
+            rows = {
+                "query_tensors": tokens_h[:, :P_full],
+                "query_mask": mask_h[:, :P_full],
+                "response_tensors": tokens_h[:, P_full:],
+                "response_mask": mask_h[:, P_full:],
+                "logprobs": logprobs,
+                "values": values,
+                "rewards": rewards,
+            }
+            if record_staleness:
+                rows["staleness"] = np.full(
+                    (tokens_h.shape[0], 1), float(staleness), dtype=np.float32
+                )
+            store.push_batch(rows)
+            push_s += time.time() - t0
+            span_complete("rollout/push", t0, rows=int(tokens_h.shape[0]))
+
+        def finish_chunk(ctx, scored):
+            # Device scoring + pulls + store push; make_experience thread
+            # only, so device program order stays deterministic. The engine
+            # path always scores UNFUSED (full policy forward): sampled-token
+            # stats never rode along with slot decode.
+            nonlocal score_s, last_scores, last_kl
+            scores, reward_call = scored
+            t0 = time.time()
+            logprobs, values, rewards, kl = rl.rollout_score(
+                ctx["tokens"], ctx["mask"], scores, snapshot=snapshot
+            )
+            logprobs, values, rewards, kl = rl.to_local_host((logprobs, values, rewards, kl))
+            score_s += time.time() - t0
+            span_complete("rollout/score_device", t0, step=iter_count)
+            push_rows(ctx["tokens_h"], ctx["mask_h"], logprobs, values, rewards)
+            if monitor is not None:
+                monitor.observe_chunk(
+                    ctx["tokens_h"],
+                    ctx["mask_h"],
+                    P_full,
+                    scores=scores,
+                    weight_version=weight_version,
+                    staleness=staleness,
+                    step=iter_count,
+                    reward_call=reward_call,
+                )
+            last_scores, last_kl = np.asarray(scores), kl
+
+        def host_score(args):
+            # Same host boundary as the chunked path (see make_experience's
+            # host_score for the multi-host rationale).
+            tokens_h, mask_h = args
+            with trace_span("rollout/decode", step=iter_count):
+                texts_or_tokens = rl.decode(tokens_h, mask_h)
+            with trace_span("rollout/reward_fn", step=iter_count):
+                scores = np.asarray(self.score(texts_or_tokens), dtype=np.float32)
+            return scores, self._reward_calls
+
+        def assemble(eps):
+            # Episodes arrive at their bucket widths; left-pad the prompt
+            # region to the trainer's global width (pad rows mask-0, same
+            # rule as the chunked push_rows) so ONE score program shape
+            # serves every chunk.
+            n = len(eps)
+            tokens_h = np.full((n, P_full + R), pad_id, dtype=np.int32)
+            mask_h = np.zeros((n, P_full + R), dtype=np.int32)
+            for i, e in enumerate(eps):
+                w = int(e.prompt_ids.shape[0])
+                tokens_h[i, P_full - w : P_full] = e.prompt_ids
+                mask_h[i, P_full - w : P_full] = e.prompt_mask
+                tokens_h[i, P_full:] = e.response_ids
+                mask_h[i, P_full:] = e.response_mask
+                episode_steps.append(int(e.decode_steps))
+            dev = rl.put_batch({"tokens": tokens_h, "mask": mask_h})
+            return {
+                "tokens": dev["tokens"],
+                "mask": dev["mask"],
+                "tokens_h": tokens_h,
+                "mask_h": mask_h,
+            }
+
+        worker = None
+        inflight = None
+        depth = 0
+        if use_worker:
+            depth = max(1, int(getattr(rl.config.method, "score_queue_depth", 2) or 2))
+            worker = ScoreWorker(host_score, depth=depth)
+            inflight = deque()
+
+        finished_buf = []
+        aborted = False
+        ok = False
+        try:
+            while n_collected < num_rollouts:
+                if stop is not None and stop():
+                    aborted = True
+                    engine.abort()
+                    return
+                if heartbeat is not None:
+                    heartbeat.beat(step=iter_count, phase="rollout")
+                t = time.time()
+                eps = engine.step()
+                gen_s += time.time() - t
+                span_complete("rollout/generate", t, step=iter_count, engine=True)
+                finished_buf.extend(eps)
+                if not eps and engine.idle and n_collected + len(finished_buf) < num_rollouts:
+                    raise RuntimeError(
+                        "rollout engine went idle before the phase collected "
+                        f"{num_rollouts} episodes (have {n_collected + len(finished_buf)})"
+                    )
+                # Flush full chunks — plus the final partial chunk once every
+                # submitted prompt has come back.
+                while len(finished_buf) >= chunk or (
+                    finished_buf and n_collected + len(finished_buf) == num_rollouts
+                ):
+                    take = min(chunk, len(finished_buf))
+                    batch_eps, finished_buf = finished_buf[:take], finished_buf[take:]
+                    ctx = assemble(batch_eps)
+                    if worker is not None:
+                        worker.submit((ctx["tokens_h"], ctx["mask_h"]))
+                        inflight.append(ctx)
+                        while inflight and (len(inflight) > depth or worker.ready()):
+                            finish_chunk(inflight.popleft(), worker.result())
+                    else:
+                        t = time.time()
+                        scored = host_score((ctx["tokens_h"], ctx["mask_h"]))
+                        reward_s += time.time() - t
+                        finish_chunk(ctx, scored)
+                    n_collected += take
+            if worker is not None:
+                while inflight:
+                    if stop is not None and stop():
+                        aborted = True
+                        engine.abort()
+                        return
+                    finish_chunk(inflight.popleft(), worker.result())
+            ok = True
+        finally:
+            if not ok:
+                # Error or stop mid-phase: drop queued prompts and in-flight
+                # slots so the NEXT phase's episode count starts from zero —
+                # a leftover slot would otherwise leak a stale-weights
+                # episode into it.
+                engine.abort()
+            if worker is not None:
+                worker.close()
+                reward_s += worker.busy_s
+            if timer is not None and not aborted:
+                timer.add("rollout", gen_s + score_s + push_s)
+                timer.add("score", reward_s)
+        if aborted:
+            return
+
+        eng = engine.stats(reset=True)
+        exp_time = clock.tick()
+        stats = {
+            "exp_time": exp_time,
+            "exp_gen_s": gen_s,
+            "exp_reward_s": reward_s,
+            "exp_score_s": score_s,
+            "exp_push_s": push_s,
+            # Engine-BLOCKED rate (admission + decode dispatch + harvest per
+            # step() call); the engine's own engine/decode_tokens_per_s gauge
+            # below isolates the pure jitted-decode rate.
+            "exp_decode_tokens_per_s": float(eng.get("engine/gen_tokens", 0.0))
+            / max(gen_s, 1e-9),
+            "exp_decode_steps": float(eng.get("engine/decode_steps", 0.0)),
+            "exp_decode_step_budget": float(R),
+            # Same key as the chunked path: per-episode steps. Here the gap
+            # to decode_step_budget is RECLAIMED by slot refill rather than
+            # paid as straggler idle time.
+            "exp_decode_steps_per_episode": (
+                float(np.mean(episode_steps)) if episode_steps else 0.0
+            ),
+            "rollout_mean_score": float(np.mean(last_scores)),
+            "rollout_mean_kl": float(np.mean(np.asarray(last_kl).sum(-1))),
+            "exp_per_sec": num_rollouts / max(exp_time, 1e-9),
+        }
+        stats.update(eng)
+        if record_staleness:
+            stats["exp_staleness"] = float(staleness)
         rl._last_exp_stats = {"exp_per_sec": stats["exp_per_sec"]}
         rl.tracker.log(stats, step=iter_count)

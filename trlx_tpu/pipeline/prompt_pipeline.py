@@ -31,6 +31,53 @@ def normalize_buckets(widths: Optional[Sequence[int]], max_width: int):
     return tuple(ws)
 
 
+class PromptSlotQueue:
+    """Width-grouped FIFO feeding the continuous-batching engine's slot
+    admission (trlx_tpu.engine).
+
+    PR 4's prompt-length bucketing becomes slot admission here: prompts are
+    queued at their bucket width, and the engine prefills a same-width GROUP
+    of them into free slots in one batched prefill call. ``pop_group`` hands
+    back up to ``limit`` rows of a single width — the width with the most
+    queued prompts, so prefill batches stay as full as possible while every
+    width still drains (FIFO within a width)."""
+
+    def __init__(self):
+        self._queues = {}  # width -> list of (ids [w], mask [w]) host rows
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def push_rows(self, input_ids, attention_mask) -> int:
+        """Queue a [n, width] host batch as n single-prompt rows."""
+        ids = np.asarray(input_ids)
+        msk = np.asarray(attention_mask)
+        width = int(ids.shape[1])
+        q = self._queues.setdefault(width, [])
+        for i in range(ids.shape[0]):
+            q.append((ids[i], msk[i]))
+        return ids.shape[0]
+
+    def pop_group(self, limit: int):
+        """Dequeue up to ``limit`` same-width prompts (the fullest width
+        first). Returns (width, ids [j, width], mask [j, width]) or None."""
+        if limit <= 0 or len(self) == 0:
+            return None
+        width = max(
+            (w for w, q in self._queues.items() if q),
+            key=lambda w: len(self._queues[w]),
+        )
+        q = self._queues[width]
+        j = min(limit, len(q))
+        taken, self._queues[width] = q[:j], q[j:]
+        ids = np.stack([t[0] for t in taken])
+        msk = np.stack([t[1] for t in taken])
+        return width, ids, msk
+
+    def clear(self):
+        self._queues.clear()
+
+
 @register_datapipeline
 class PromptPipeline(BasePipeline):
     """Tokenizes and left-pads a list of prompts.

@@ -532,6 +532,13 @@ class JaxBaseTrainer(BaseRLTrainer):
             "gen_tokens": int(resp.sum()),
             "decode_steps": int(resp.any(axis=0).sum()),
             "decode_step_budget": int(resp.shape[1]),
+            # Per-EPISODE decode steps (response masks are contiguous from
+            # position 0, so the row sum IS each row's step count). The
+            # whole-batch decode_steps above is what the static batch PAID —
+            # max over rows; the per-episode view is what each row USED, and
+            # the gap between their means is the straggler overhead the
+            # continuous-batching engine removes.
+            "episode_steps": resp.sum(axis=1).astype(np.int64),
         }
 
     def next_rng(self):

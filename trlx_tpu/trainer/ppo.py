@@ -170,6 +170,10 @@ class PPOTrainer(JaxBaseTrainer):
             def processor(logits, state):
                 return process_logits_default(bigram(logits, state), gcfg, state["step"])
 
+        # The continuous-batching engine reuses the exact same processor
+        # chain (its per-slot state passes step as a [n_slots, 1] column,
+        # which broadcasts identically against [n_slots, vocab] logits).
+        self._gen_processor = processor
         self._generate_fn = make_generate_fn(
             self.model,
             self.gen_cfg,
@@ -255,6 +259,44 @@ class PPOTrainer(JaxBaseTrainer):
                 monitor=getattr(self, "_devicemon", None),
                 monitor_name="rollout/generate_fused",
             )
+
+        # Continuous-batching rollout engine (trlx_tpu/engine): slot-based
+        # decode behind the RolloutEngine boundary — finished sequences free
+        # their slot immediately and queued prompts are prefilled into them,
+        # so mixed response lengths stop paying the whole-chunk straggler
+        # cost. Off by default; the chunked path above stays byte-identical.
+        self.rollout_engine_enabled = bool(getattr(m, "rollout_engine", False))
+        self._rollout_engine = None
+        if self.rollout_engine_enabled:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "method.rollout_engine is single-host only: the engine's "
+                    "host-side slot manager admits prompts data-dependently, "
+                    "so multi-controller hosts would dispatch different "
+                    "device programs. Use the chunked rollout path on pods."
+                )
+            if self._qw is not None:
+                raise ValueError(
+                    "method.rollout_engine is incompatible with "
+                    "model.decode_weight_quant: the engine scores episodes "
+                    "through the unfused re-forward, which would recompute "
+                    "behavior logprobs at full precision against int8-sampled "
+                    "tokens — the silent off-policy bias the fused-stats "
+                    "validation exists to prevent. Disable one of them."
+                )
+            if self.model.cfg.n_soft_tokens > 0:
+                raise ValueError(
+                    "method.rollout_engine does not support soft prompts yet: "
+                    "per-slot prefill would need to replay the soft prefix on "
+                    "every admission. Use the chunked rollout path."
+                )
+            if config.model.has_reward_model:
+                raise ValueError(
+                    "method.rollout_engine does not support the on-device "
+                    "reward-model scoring path yet — episodes stream out per "
+                    "slot and are scored through the host reward_fn chunks. "
+                    "Use the chunked rollout path with has_reward_model."
+                )
 
         # On-device learned reward model: a second LM + scalar head, sharded
         # with the SAME partition rules as the policy and scored inside the
@@ -417,6 +459,36 @@ class PPOTrainer(JaxBaseTrainer):
         if self._qw is not None:
             v["qw"] = self._qw
         return v
+
+    def rollout_engine(self):
+        """The lazily-built continuous-batching engine (method.rollout_engine
+        on). ONE engine per trainer: it owns the slot KV cache and keeps it
+        across experience phases; weights are handed over per phase via
+        update_weights (see orchestrator._make_experience_engine)."""
+        if self._rollout_engine is None:
+            from trlx_tpu.engine import RolloutEngine
+
+            m = self.config.method
+            n_slots = int(getattr(m, "engine_slots", 0) or 0) or int(m.chunk_size)
+            self._rollout_engine = RolloutEngine(
+                self.model,
+                self.gen_cfg,
+                n_slots=n_slots,
+                prompt_width=self.prompt_length,
+                processor=self._gen_processor,
+                prefill_batch=int(getattr(m, "prefill_batch", 4) or 4),
+                steps_per_sync=int(getattr(m, "engine_steps_per_sync", 8) or 8),
+                dispatch_lock=self._dispatch_lock,
+                monitor=getattr(self, "_devicemon", None),
+                rng=self.next_rng(),
+            )
+        return self._rollout_engine
+
+    def rollout_engine_variables(self, snapshot=None):
+        """The engine's versioned weight handoff payload: the same decode
+        variable collections the chunked path resolves per call — but taken
+        ONCE per phase boundary, so the engine never reads donated state."""
+        return self._decode_variables(snapshot)
 
     def _refresh_decode_weights(self):
         """Re-quantize the int8 decode kernels from the LIVE policy — called
@@ -801,6 +873,12 @@ class PPOTrainer(JaxBaseTrainer):
         if producer is not None:
             self._rollout_producer = None
             producer.shutdown()
+        engine = self._rollout_engine
+        if engine is not None:
+            # Synchronous (the engine owns no threads): drop queued prompts,
+            # in-flight slots, the device state, and the weight reference.
+            self._rollout_engine = None
+            engine.shutdown()
 
 
 def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detach_frozen):
