@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost lint typecheck quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -41,6 +41,23 @@ test-shard3:
 test-multihost:
 	$(TEST_ENV) python -m pytest -q -m slow \
 	    tests/test_multihost.py tests/test_distributed_resilience.py
+
+# graftlint: AST invariant checks (RUNBOOK §11). Blocking, < 30 s, stdlib
+# only — the analysis package must never import jax (pinned by
+# tests/test_analysis.py), so this runs on CPU-only CI images as-is.
+lint:
+	python -m trlx_tpu.analysis trlx_tpu/
+
+# Non-blocking type pass over the typed subset (analysis + engine). Degrades
+# to a notice when mypy isn't installed — nothing at runtime needs it, and
+# the container must not pip install.
+typecheck:
+	@if python -c "import mypy" 2>/dev/null; then \
+	    python -m mypy --ignore-missing-imports --follow-imports=silent \
+	        trlx_tpu/analysis/ trlx_tpu/engine/; \
+	else \
+	    echo "mypy not installed; skipping typecheck (advisory only)"; \
+	fi
 
 quality:
 	ruff check trlx_tpu/ tests/ examples/ bench.py

@@ -298,6 +298,66 @@ def test_engine_requires_weight_handoff_and_bounds_prompt_width():
     engine.shutdown()
 
 
+def test_sanitizer_catches_unlocked_engine_dispatch(monkeypatch):
+    """TRLX_TPU_SANITIZE=dispatch acceptance: an intentionally unlocked
+    decode dispatch from a trlx-* worker thread raises DispatchLockViolation
+    naming the program, while the engine's own locked dispatches still run."""
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "dispatch")
+    try:
+        lock = sanitize.make_dispatch_lock()
+        assert isinstance(lock, sanitize.SanitizedDispatchLock)
+        model, params, _, _ = _tiny_model()
+        gcfg = GenerateConfig(max_new_tokens=3, do_sample=False, pad_token_id=0)
+        engine = RolloutEngine(
+            model, gcfg, n_slots=2, prompt_width=4, dispatch_lock=lock
+        )
+        engine.update_weights(params)
+        engine.submit(np.ones((1, 4), np.int32), np.ones((1, 4), np.int32))
+        assert engine.step() is not None  # locked path works under the sanitizer
+
+        errors = []
+
+        def rogue():
+            try:
+                # the PR 5 bug, replayed on purpose: dispatch without the lock
+                engine._decode(engine._variables, engine._state)
+            except sanitize.DispatchLockViolation as e:
+                errors.append(e)
+
+        t = threading.Thread(target=rogue, name="trlx-rogue-dispatcher")
+        t.start()
+        t.join()
+        assert len(errors) == 1 and "engine/decode" in str(errors[0])
+        engine.shutdown()
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+
+
+def test_sanitizer_catches_donated_weight_handoff(monkeypatch):
+    """TRLX_TPU_SANITIZE=donation acceptance: handing the engine a tree that
+    was donated to a jitted program fails at update_weights with the donation
+    site, instead of a deleted-array error mid-decode."""
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "donation")
+    try:
+        sanitize.refresh()
+        model, params, _, _ = _tiny_model()
+        gcfg = GenerateConfig(max_new_tokens=3, do_sample=False, pad_token_id=0)
+        engine = RolloutEngine(model, gcfg, n_slots=2, prompt_width=4)
+        sanitize.mark_donated(params, "train_step(state) [drill]")
+        with pytest.raises(sanitize.DonatedBufferRead, match="train_step"):
+            engine.update_weights(params)
+        engine.shutdown()
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
+
+
 # ------------------------------------------------------------ e2e acceptance
 
 

@@ -337,8 +337,20 @@ def test_overlap_at_staleness_zero_matches_serial_exactly(task, tmp_path):
     assert not any("staleness/mean" in r for r in serial)
 
 
-def test_max_staleness_one_trains_and_reports_staleness(task, tmp_path):
-    model, records = _run_ppo(task, tmp_path / "stale", max_staleness=1)
+def test_max_staleness_one_trains_and_reports_staleness(task, tmp_path, monkeypatch):
+    # Armed sanitizer (utils/sanitize): the overlapped pipeline's producer /
+    # score-worker threads dispatch concurrently with the train loop, so this
+    # run doubles as the proof that every dispatch site holds the lock and no
+    # donated buffer is read back — violations raise instead of deadlocking.
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "dispatch,donation")
+    try:
+        model, records = _run_ppo(task, tmp_path / "stale", max_staleness=1)
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
     assert model.iter_count >= 8
     stale = [r["staleness/mean"] for r in records if "staleness/mean" in r]
     # iteration 0's store is on-policy; every later batch is 1 stale

@@ -1,0 +1,159 @@
+"""Runtime sanitizer (utils/sanitize, TRLX_TPU_SANITIZE) contract tests.
+
+Two halves, mirroring the module:
+
+- unarmed: ZERO residue — plain RLock, identity wrap, no-op mark/check;
+- armed: dispatch-lock ownership asserted whenever other trlx-* threads are
+  alive, and donated-buffer host reads raise naming the donation site.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from trlx_tpu.utils import sanitize
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_state(monkeypatch):
+    """Each test starts unarmed and leaves no residue: monkeypatch restores
+    the env; we re-sync the module global and drop donation records."""
+    monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+    sanitize.refresh()
+    yield monkeypatch
+    monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+    sanitize.refresh()
+    sanitize.clear_donated()
+
+
+def _arm(monkeypatch, modes):
+    monkeypatch.setenv(sanitize.ENV_VAR, modes)
+    sanitize.refresh()
+
+
+# ---------------------------------------------------------------- unarmed
+
+
+def test_unarmed_lock_is_plain_rlock():
+    lock = sanitize.make_dispatch_lock()
+    assert not isinstance(lock, sanitize.SanitizedDispatchLock)
+    with lock:  # still a working RLock
+        pass
+
+
+def test_unarmed_wrap_is_identity():
+    def fn(x):
+        return x + 1
+
+    lock = sanitize.make_dispatch_lock()
+    assert sanitize.wrap_dispatch("prog", fn, lock) is fn
+    # even a None lock (engine built without one) keeps identity
+    assert sanitize.wrap_dispatch("prog", fn, None) is fn
+
+
+def test_unarmed_mark_and_check_are_noops():
+    buf = np.zeros((2, 2), np.float32)
+    sanitize.mark_donated({"w": buf}, "nowhere")
+    sanitize.check_host_read({"w": buf}, "read")  # must not raise
+
+
+def test_unknown_mode_raises():
+    import os
+
+    os.environ[sanitize.ENV_VAR] = "dispatch,bogus"
+    with pytest.raises(ValueError, match="bogus"):
+        sanitize.refresh()
+    del os.environ[sanitize.ENV_VAR]
+    sanitize.refresh()
+
+
+# --------------------------------------------------------------- dispatch
+
+
+def test_armed_lock_tracks_ownership(_sanitize_state):
+    _arm(_sanitize_state, "dispatch")
+    lock = sanitize.make_dispatch_lock()
+    assert isinstance(lock, sanitize.SanitizedDispatchLock)
+    assert not lock.owned()
+    with lock:
+        assert lock.owned()
+        with lock:  # reentrant
+            assert lock.owned()
+        assert lock.owned()
+    assert not lock.owned()
+
+
+def test_armed_wrap_catches_unlocked_dispatch_from_worker_thread(_sanitize_state):
+    _arm(_sanitize_state, "dispatch")
+    lock = sanitize.make_dispatch_lock()
+    calls = []
+    wrapped = sanitize.wrap_dispatch("test/prog", lambda: calls.append(1), lock)
+    assert wrapped.__wrapped__ is not None  # actually wrapped when armed
+
+    errors = []
+
+    def rogue():
+        try:
+            wrapped()  # intentionally unlocked — the PR 5 bug shape
+        except sanitize.DispatchLockViolation as e:
+            errors.append(e)
+
+    t = threading.Thread(target=rogue, name="trlx-rogue-dispatcher")
+    t.start()
+    t.join()
+    assert len(errors) == 1 and "test/prog" in str(errors[0])
+    assert calls == []  # the dispatch was blocked, not executed
+
+    # the same dispatch under the lock goes through
+    def locked():
+        with lock:
+            wrapped()
+
+    t = threading.Thread(target=locked, name="trlx-locked-dispatcher")
+    t.start()
+    t.join()
+    assert calls == [1]
+
+
+def test_armed_wrap_allows_serial_main_thread(_sanitize_state):
+    """No other trlx-* thread alive → no hazard → unlocked main-thread
+    dispatch is fine (the serial path must not need the lock)."""
+    _arm(_sanitize_state, "dispatch")
+    lock = sanitize.make_dispatch_lock()
+    wrapped = sanitize.wrap_dispatch("p", lambda: "ok", lock)
+    assert wrapped() == "ok"
+
+
+# --------------------------------------------------------------- donation
+
+
+def test_armed_donation_roundtrip_names_site(_sanitize_state):
+    _arm(_sanitize_state, "donation")
+    buf = np.zeros((4,), np.float32)
+    tree = {"params": {"w": buf}, "step": 3}
+    sanitize.mark_donated(tree, "train_step(state) [test]")
+    with pytest.raises(sanitize.DonatedBufferRead, match=r"train_step\(state\)"):
+        sanitize.check_host_read({"w": buf}, "checkpoint save")
+    # unrelated buffers pass
+    sanitize.check_host_read({"w": np.ones((4,), np.float32)}, "other")
+    sanitize.clear_donated()
+    sanitize.check_host_read({"w": buf}, "after clear")  # records dropped
+
+
+def test_donation_walks_nested_containers(_sanitize_state):
+    _arm(_sanitize_state, "donation")
+    a, b = np.zeros((1,)), np.ones((2,))
+    sanitize.mark_donated([{"x": (a,)}, b], "nested")
+    for leaf in (a, b):
+        with pytest.raises(sanitize.DonatedBufferRead):
+            sanitize.check_host_read(leaf if leaf is b else {"k": [leaf]}, "read")
+        sanitize.clear_donated()
+        sanitize.mark_donated([{"x": (a,)}, b], "nested")
+
+
+def test_donation_registry_is_capped(_sanitize_state):
+    _arm(_sanitize_state, "donation")
+    keep = [np.zeros((1,)) for _ in range(sanitize._DONATED_CAP + 10)]
+    sanitize.mark_donated(keep, "bulk")
+    assert len(sanitize._DONATED) <= sanitize._DONATED_CAP

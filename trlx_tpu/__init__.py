@@ -12,10 +12,21 @@ Public API mirrors the reference's single entry point
     import trlx_tpu
     trlx_tpu.train("gpt2", reward_fn=...)          # online PPO
     trlx_tpu.train("gpt2", dataset=(samples, rs))  # offline ILQL
-"""
 
-from trlx_tpu.trlx import train  # noqa: F401  (public API re-export)
+The ``train`` export is lazy (PEP 562): bare ``import trlx_tpu`` must stay
+jax-free so jax-less subsystems (``python -m trlx_tpu.analysis``, the
+CPU-only `make lint` CI job) can import the package without the accelerator
+stack.
+"""
 
 __version__ = "0.1.0"
 
 __all__ = ["train", "__version__"]
+
+
+def __getattr__(name):
+    if name == "train":
+        from trlx_tpu.trlx import train
+
+        return train
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

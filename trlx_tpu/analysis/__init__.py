@@ -1,0 +1,28 @@
+"""graftlint — the repo's stdlib-ast static-analysis suite.
+
+CLI:  ``python -m trlx_tpu.analysis [paths...] [--json] [--select GL001,...]``
+
+The suite encodes the invariants this codebase learned the hard way (PR 5
+dispatch deadlock, PR 3 Mosaic tile crash, PR 9 metric-name collisions) as
+seven machine-checked rules, GL001–GL007 — see RUNBOOK §11 for the rule
+table and the suppression policy. Importing this package must never import
+jax: it runs as a blocking `make lint` on CPU-only CI images.
+"""
+
+from trlx_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Module,
+    RULE_TITLES,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+__all__ = [
+    "Finding",
+    "Module",
+    "RULE_TITLES",
+    "lint_paths",
+    "render_json",
+    "render_text",
+]

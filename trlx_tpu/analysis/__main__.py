@@ -1,0 +1,63 @@
+"""graftlint CLI: ``python -m trlx_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
+findings, 2 = usage error. ``--json`` emits the machine-readable findings
+document (also containing suppressed findings, flagged as such, so review
+tooling can audit the waivers).
+"""
+
+import argparse
+import sys
+
+from trlx_tpu.analysis.core import (
+    RULE_TITLES,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.analysis",
+        description="graftlint: repo-specific AST invariant checks (GL001-GL007)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["trlx_tpu"],
+        help="files or directories to lint (default: trlx_tpu)",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON findings output")
+    parser.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, title in sorted(RULE_TITLES.items()):
+            print(f"{rule}  {title}")
+        return 0
+
+    select = [r.strip() for r in args.select.split(",") if r.strip()] or None
+    if select:
+        unknown = [r for r in select if r not in RULE_TITLES]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    findings, n_files = lint_paths(args.paths, select=select)
+    if args.json:
+        print(render_json(findings, n_files))
+    else:
+        print(render_text(findings, n_files))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ from trlx_tpu.models.lm import init_cache
 from trlx_tpu.observability.spans import trace_span
 from trlx_tpu.ops.sampling import GenerateConfig, process_logits_default
 from trlx_tpu.pipeline.prompt_pipeline import PromptSlotQueue
+from trlx_tpu.utils import sanitize
 
 
 @dataclass
@@ -129,6 +130,10 @@ class RolloutEngine:
         self._traces = {"decode": 0, "prefill": 0}
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
+        # Identity unless TRLX_TPU_SANITIZE=dispatch armed the lock we were
+        # handed — then every engine dispatch asserts lock ownership.
+        self._decode = sanitize.wrap_dispatch("engine/decode", self._decode, dispatch_lock)
+        self._prefill = sanitize.wrap_dispatch("engine/prefill", self._prefill, dispatch_lock)
         if monitor is not None:
             self._decode = monitor.wrap(
                 "engine/decode_step", self._decode, phase="rollout"
@@ -177,6 +182,10 @@ class RolloutEngine:
         """Explicit versioned weight handoff: ``variables`` is the decode
         variable dict (params [+ int8 qw]) from the trainer's snapshot /
         re-quantize path — a stable copy, never the live donated state."""
+        # Sanitizer checkpoint: handing the engine a donated tree (e.g. the
+        # trainer's pre-train_step state instead of the snapshot) fails HERE
+        # with the donation site, not mid-decode with a deleted-array error.
+        sanitize.check_host_read(variables, "engine.update_weights")
         self._variables = variables
         self.weight_version = version
 
@@ -209,7 +218,11 @@ class RolloutEngine:
         t0 = time.time()
         with trace_span("engine/decode", slots=n_live, steps=self.steps_per_sync):
             with self._dispatch():
+                prev_state = self._state
                 self._state, live_steps = self._decode(self._variables, self._state)
+            # _decode donates the slot state (donate_argnums=(1,)).
+            sanitize.mark_donated(prev_state, "engine._decode(state) [step]")
+            del prev_state
         finished, n_gen, live_steps = jax.device_get(
             (self._state["finished"], self._state["n_gen"], live_steps)
         )
@@ -268,6 +281,7 @@ class RolloutEngine:
             t0 = time.time()
             with trace_span("engine/prefill", n=int(ids.shape[0]), width=int(width)):
                 with self._dispatch():
+                    prev_state = self._state
                     self._state = self._prefill(
                         self._variables,
                         self._state,
@@ -275,6 +289,9 @@ class RolloutEngine:
                         jnp.asarray(msk),
                         jnp.asarray(slots),
                     )
+                # _prefill donates the slot state (donate_argnums=(1,)).
+                sanitize.mark_donated(prev_state, "engine._prefill(state) [admit]")
+                del prev_state
             self._prefill_wall += time.time() - t0
             for row, slot in enumerate(slots):
                 self._slot_meta[int(slot)] = {

@@ -151,7 +151,7 @@ class IncidentCapture:
                 return
             import warnings
 
-            from trlx_tpu.utils.logging import read_jsonl
+            from trlx_tpu.utils.jsonl import read_jsonl
 
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")  # a torn tail is fine here

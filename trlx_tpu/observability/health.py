@@ -52,6 +52,8 @@ from dataclasses import asdict, dataclass
 
 import numpy as np
 
+from trlx_tpu.utils import jsonl
+
 __all__ = [
     "OK",
     "WARN",
@@ -508,8 +510,9 @@ class HealthMonitor:
             self._staleness_since_hist.append(float(staleness))
             if self.lineage_path:
                 try:
-                    with open(self.lineage_path, "a") as f:
-                        f.write(record.to_json() + "\n")
+                    # Line-atomic single-write append (utils/jsonl contract):
+                    # a killed host tears at most the final lineage record.
+                    jsonl.append_record(self.lineage_path, asdict(record))
                 except OSError:
                     pass  # lineage is an audit trail, never a crash source
 

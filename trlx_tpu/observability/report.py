@@ -62,7 +62,7 @@ def rollup_window_stats(stats: dict) -> dict:
 
 
 def _load_jsonl(path):
-    from trlx_tpu.utils.logging import read_jsonl
+    from trlx_tpu.utils.jsonl import read_jsonl
 
     if not os.path.exists(path):
         return []

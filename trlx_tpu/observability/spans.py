@@ -37,11 +37,11 @@ Event vocabulary (the Chrome trace-event format's subset we emit):
   lazily at the thread's first event, so lanes carry the ``trlx-*`` names.
 """
 
-import json
-import os
 import threading
 import time
 import warnings
+
+from trlx_tpu.utils import jsonl
 
 __all__ = [
     "configure",
@@ -76,10 +76,9 @@ class SpanTracer:
     """Appends Chrome trace events to one JSONL file, line-atomically."""
 
     def __init__(self, path: str, process_index: int = 0):
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
         self.path = path
         self.pid = int(process_index)
-        self._file = open(path, "ab", buffering=0)
+        self._file = jsonl.open_line_atomic(path)
         # Synthetic per-thread-OBJECT lane ids, stored thread-locally. Raw
         # thread.ident would be simpler but the OS reuses idents: a rollout
         # producer starting after an epoch's prefetch thread exits can
@@ -92,7 +91,7 @@ class SpanTracer:
     def _emit(self, event: dict):
         try:
             # ONE write call per record → line-atomic under O_APPEND.
-            self._file.write((json.dumps(event) + "\n").encode("utf-8"))
+            jsonl.write_record(self._file, event)
         except (OSError, ValueError):
             # ValueError: write on a closed file (late event during teardown).
             # Tracing must never take down the run it observes — disarm.
@@ -232,9 +231,7 @@ def instant(name: str, **args):
 
 
 def read_spans(path: str):
-    """Parse a spans.jsonl, tolerating a torn final line — the same contract
-    as utils.logging.read_jsonl (a killed writer tears at most the tail;
-    mid-file corruption still raises)."""
-    from trlx_tpu.utils.logging import read_jsonl
-
-    return read_jsonl(path)
+    """Parse a spans.jsonl, tolerating a torn final line — the shared
+    utils.jsonl contract (a killed writer tears at most the tail; mid-file
+    corruption still raises)."""
+    return jsonl.read_jsonl(path)

@@ -200,6 +200,15 @@ def _fwd(q, k, v, kmask, off, scale, causal, window, bq, bk, interpret):
     BH, T, D = q.shape
     nq, nk = T // bq, T // bk
     H = BH // kmask.shape[0]
+    if not interpret:
+        # GL006 provenance: the _vmem_spec shapes below must agree with the
+        # canonical tiling.flash_block_layout description — validating the
+        # layout before compiling keeps wrapper and validator from drifting
+        # (the PR 3 Mosaic tile-rule crash class). Interpret mode has no
+        # Mosaic tile constraints, so tiny CPU test shapes stay legal.
+        from trlx_tpu.ops.tiling import check_layout, flash_block_layout
+
+        check_layout(flash_block_layout(BH, T, D, bq, bk))
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window, bq=bq, bk=bk
     )
@@ -337,6 +346,14 @@ def _flash_lse_bwd(scale, causal, window, bq, bk, interpret, res, cts):
         - dlse.astype(jnp.float32)
     )  # [BH, 1, T]
     nq, nk = T // bq, T // bk
+
+    if not interpret:
+        # GL006 provenance: the backward kernels tile the same (block, array)
+        # families as the forward (q/k/v blocks plus the [BH,1,T] row
+        # vectors), so the forward layout is the legality contract here too.
+        from trlx_tpu.ops.tiling import check_layout, flash_block_layout
+
+        check_layout(flash_block_layout(BH, T, D, bq, bk))
 
     common = dict(scale=scale, causal=causal, window=window, bq=bq, bk=bk)
     in_arrays = (off, kmask, q, k, v, do, lse, delta)

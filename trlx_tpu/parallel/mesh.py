@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from trlx_tpu.utils import sanitize
+
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
@@ -135,6 +137,10 @@ def to_local_host(tree, mesh: Optional[Mesh] = None, batch_axes=DATA_AXES):
     global array would throw on non-addressable shards. Single-process (and
     for host numpy passed through): a plain np.asarray.
     """
+    # Sanitizer checkpoint: pulling a donated buffer to host is the classic
+    # use-after-donate read — fail here with the donation site, not with
+    # jax's anonymous "Array has been deleted" downstream.
+    sanitize.check_host_read(tree, "to_local_host")
 
     def pull(x):
         if jax.process_count() == 1 or not isinstance(x, jax.Array):
@@ -145,7 +151,7 @@ def to_local_host(tree, mesh: Optional[Mesh] = None, batch_axes=DATA_AXES):
         spec = PartitionSpec(batch_axes, *([None] * (x.ndim - 1)))
         m = mesh if mesh is not None else get_mesh()
         return np.asarray(
-            multihost_utils.global_array_to_host_local_array(x, m, spec)
+            multihost_utils.global_array_to_host_local_array(x, m, spec)  # graftlint: disable=GL004 -- pull() only runs inside the collective_guard("to_local_host") tree_map below
         )
 
     if jax.process_count() > 1:
@@ -194,6 +200,24 @@ def barrier(name: str = "trlx_tpu_barrier"):
 
         with collective_guard(f"barrier:{name}"):
             multihost_utils.sync_global_devices(name)
+
+
+def broadcast_host(value):
+    """Rank-0's host value → every process (the guarded counterpart of a bare
+    ``multihost_utils.broadcast_one_to_all``). Used for process-agreed
+    decisions (e.g. "does a checkpoint exist?") that every host must answer
+    identically before entering a collective code path. Single-process:
+    identity."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    from trlx_tpu.resilience.distributed import collective_guard
+
+    # Guarded: a broadcast with a dead coordinator never completes — abort
+    # with CollectiveTimeout after train.collective_deadline instead.
+    with collective_guard("broadcast_host"):
+        return multihost_utils.broadcast_one_to_all(value)
 
 
 def is_main_process() -> bool:

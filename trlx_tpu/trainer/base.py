@@ -53,6 +53,7 @@ from trlx_tpu.resilience import distributed as dist_res
 from trlx_tpu.resilience.faults import poison_nan
 from trlx_tpu.trainer import BaseRLTrainer
 from trlx_tpu.utils import Clock
+from trlx_tpu.utils import sanitize
 from trlx_tpu.utils.logging import Tracker
 
 
@@ -182,7 +183,9 @@ class JaxBaseTrainer(BaseRLTrainer):
         # this lock across the dispatch call (not the execution — dispatch is
         # async) keeps every device queue in one global program order.
         # Uncontended acquire is ~100ns; the serial path never contends.
-        self._dispatch_lock = threading.RLock()
+        # (A plain RLock unless TRLX_TPU_SANITIZE=dispatch arms the
+        # ownership-asserting variant — utils/sanitize.py.)
+        self._dispatch_lock = sanitize.make_dispatch_lock()
         self.tokenizer = self._build_tokenizer(config.model.tokenizer_path)
 
         # Subclass builds the Flax module + initial host params.
@@ -371,7 +374,10 @@ class JaxBaseTrainer(BaseRLTrainer):
         """Route a jitted fn through the device-telemetry monitor — identity
         when telemetry is off, so call sites stay unconditional. getattr:
         subclass __init__ code may build programs before the base bootstrap
-        has armed the monitor."""
+        has armed the monitor. Every registered jitted program funnels
+        through here, so this is also where the dispatch sanitizer hooks in
+        (identity unless TRLX_TPU_SANITIZE=dispatch)."""
+        fn = sanitize.wrap_dispatch(name, fn, getattr(self, "_dispatch_lock", None))
         monitor = getattr(self, "_devicemon", None)
         if monitor is None:
             return fn
@@ -466,11 +472,11 @@ class JaxBaseTrainer(BaseRLTrainer):
         )
         exists = os.path.exists(latest)
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+            # GL004: the broadcast blocks on every peer — the guarded mesh
+            # helper turns a dead peer into a CollectiveTimeout abort.
+            from trlx_tpu.parallel.mesh import broadcast_host
 
-            exists = bool(
-                multihost_utils.broadcast_one_to_all(np.asarray(exists))
-            )
+            exists = bool(broadcast_host(np.asarray(exists)))
         if not exists:
             return
         self.load()
@@ -990,7 +996,14 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # on-device non-finite guard).
                         step_batch = poison_nan(device_batch)
                     with self._dispatch_lock:
+                        prev_state = self.state
                         self.state, stats = self.train_step(self.state, step_batch)
+                    # Donation handoff: train_step donates the old state
+                    # (donate_argnums=(0,)); record it so a stale host read
+                    # raises with this site named (no-op unless
+                    # TRLX_TPU_SANITIZE=donation).
+                    sanitize.mark_donated(prev_state, "train_step(state) [learn loop]")
+                    del prev_state
                     self.iter_count += 1
                     if self.heartbeat is not None:
                         # Progress stamp (cheap attribute stores; the

@@ -30,6 +30,7 @@ from trlx_tpu.ops.sampling import NEG_INF, GenerateConfig
 from trlx_tpu.resilience.guard import guarded_update
 from trlx_tpu.trainer import register_model
 from trlx_tpu.trainer.base import JaxBaseTrainer
+from trlx_tpu.utils import sanitize
 
 
 @register_model("ilql")
@@ -146,9 +147,13 @@ class ILQLTrainer(JaxBaseTrainer):
         # Swap TARGET Q heads into the applied params: decode steers by the
         # target network (reference: trlx/model/nn/ilql_models.py:203-206).
         params = {**self.state.params, **self.state.extras}
-        tokens, mask, dstats = self._generate_fn(
-            {"params": params}, batch["i"], batch["m"], self.next_rng()
-        )
+        # GL001: eval decode can run while a producer thread is mid-dispatch
+        # (the overlap pipeline is PPO-only today, but the dispatch-lock
+        # discipline is trainer-wide — uncontended acquire is ~100ns).
+        with self._dispatch_lock:
+            tokens, mask, dstats = self._generate_fn(
+                {"params": params}, batch["i"], batch["m"], self.next_rng()
+            )
         if self.tracker.enabled:
             # Tracker gating (rank-0, not disabled) replaces the reference's
             # silent `"debug" in os.environ` switch
@@ -326,7 +331,14 @@ class ILQLTrainer(JaxBaseTrainer):
     def post_backward_callback(self, stats=None):
         """(reference: trlx/model/accelerate_ilql_model.py:46-48)"""
         if self.iter_count % self.config.method.steps_for_target_q_sync == 0:
-            new_extras = self._sync_fn(self.state.params, self.state.extras, self.config.method.alpha)
+            # GL001: polyak sync is a jitted dispatch like any other — it must
+            # enqueue under the lock so it cannot interleave with a concurrent
+            # generate/train dispatch from another thread.
+            with self._dispatch_lock:
+                prev_extras = self.state.extras
+                new_extras = self._sync_fn(self.state.params, self.state.extras, self.config.method.alpha)
+            # _sync_fn donates the old target heads (donate_argnums=(1,)).
+            sanitize.mark_donated(prev_extras, "_sync_fn(extras) [polyak sync]")
             self.state = self.state.replace(extras=new_extras)
 
     def post_epoch_callback(self):

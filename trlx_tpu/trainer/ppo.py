@@ -201,7 +201,11 @@ class PPOTrainer(JaxBaseTrainer):
             self._quantize_fn = self._wrap_monitored(
                 "rollout/quantize", jax.jit(quantize_weights), phase="rollout"
             )
-            self._qw = self._quantize_fn(self.state.params)
+            # GL001: __init__ predates any producer thread, but the warm-up
+            # quantize is still a jitted dispatch — lock it so the invariant
+            # holds unconditionally rather than by thread-lifecycle argument.
+            with self._dispatch_lock:
+                self._qw = self._quantize_fn(self.state.params)
 
         # Fused rollout statistics: the decode loop ALREADY computes every
         # policy quantity rollout scoring needs — raw logits of each sampled

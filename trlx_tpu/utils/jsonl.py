@@ -1,0 +1,69 @@
+"""Torn-tail-tolerant JSONL reading/appending — the ONE implementation.
+
+Three observability streams share the same crash contract — metrics.jsonl
+(utils/logging.Tracker), spans.jsonl (observability/spans.SpanTracer) and
+lineage.jsonl (observability/health.HealthMonitor):
+
+- **Append side**: the file is opened unbuffered (``buffering=0``) in
+  O_APPEND mode and each record lands as ONE ``write(2)`` syscall, so a
+  killed process (preemption, ``host_kill`` drill) can tear at most the
+  final line, and concurrent appenders (multi-host spans) can never
+  interleave mid-record.
+- **Read side**: a truncated trailing record is dropped with a warning —
+  every complete record before it is still good, so readers (resume
+  tooling, the report generator, anomaly snapshots) must not die on the
+  tail. A malformed line in the MIDDLE of the file is real corruption and
+  still raises.
+
+This module is stdlib-only (no jax) so the analysis/report tooling can use
+it from the CPU-only lint/report paths.
+"""
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List
+
+
+def read_jsonl(path: str) -> List[Any]:
+    """Read a JSONL file written by the line-atomic appenders, tolerating a
+    torn final line (and only the final line)."""
+    records = []
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            rest = b"".join(lines[i + 1 :]).strip()
+            if rest:
+                raise
+            warnings.warn(
+                f"{path}: dropped torn final record ({len(line)} bytes) — "
+                "the writer was killed mid-append",
+                stacklevel=2,
+            )
+            break
+    return records
+
+
+def open_line_atomic(path: str):
+    """Open ``path`` for line-atomic appends: O_APPEND + unbuffered, so each
+    :func:`write_record` call is one ``write(2)`` syscall."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    return open(path, "ab", buffering=0)
+
+
+def write_record(f, record: Dict[str, Any]) -> None:
+    """Serialize ``record`` and append it as one write call (line-atomic on a
+    file from :func:`open_line_atomic`)."""
+    f.write((json.dumps(record) + "\n").encode("utf-8"))
+
+
+def append_record(path: str, record: Dict[str, Any]) -> None:
+    """One-shot line-atomic append for low-rate streams (lineage.jsonl):
+    open-append-close per record, same single-write contract."""
+    with open(path, "ab", buffering=0) as f:
+        write_record(f, record)

@@ -10,7 +10,6 @@ counterpart of the reference's generic `debug` env switch
 `debug` name is still honored with a deprecation warning for one release.
 """
 
-import json
 import os
 import sys
 import time
@@ -26,35 +25,12 @@ except Exception:
     _HAS_WANDB = False
 
 from trlx_tpu.parallel.mesh import is_main_process
+from trlx_tpu.utils import jsonl
 
-
-def read_jsonl(path: str):
-    """Read a metrics.jsonl written by Tracker, tolerating a torn final line.
-
-    A host killed mid-append (preemption, ``host_kill`` drill) can leave a
-    truncated trailing record; every complete record before it is still
-    good, so readers (resume tooling, acceptance_network._trajectories)
-    must not die on the tail. A malformed line in the MIDDLE of the file is
-    real corruption and still raises."""
-    records = []
-    with open(path, "rb") as f:
-        lines = f.read().split(b"\n")
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            records.append(json.loads(line))
-        except json.JSONDecodeError:
-            rest = b"".join(lines[i + 1 :]).strip()
-            if rest:
-                raise
-            warnings.warn(
-                f"{path}: dropped torn final record ({len(line)} bytes) — "
-                "the writer was killed mid-append",
-                stacklevel=2,
-            )
-            break
-    return records
+# Canonical implementation lives in utils/jsonl (shared with spans/lineage);
+# re-exported here because read_jsonl grew up in this module and external
+# callers import it from here.
+from trlx_tpu.utils.jsonl import read_jsonl  # noqa: F401
 
 
 def _tracker_disabled() -> bool:
@@ -91,16 +67,14 @@ class Tracker:
                 project=project_name, name=run_name, entity=entity_name, config=config
             )
         os.makedirs(log_dir, exist_ok=True)
-        # Unbuffered O_APPEND: each record lands as ONE write(2) syscall
-        # (_write_record), so a killed process (preemption, host_kill drill)
-        # can tear at most the final line — which read_jsonl tolerates — and
-        # concurrent appenders can never interleave mid-record.
-        self._file = open(os.path.join(log_dir, "metrics.jsonl"), "ab", buffering=0)
+        # Line-atomic append contract shared with spans/lineage — see
+        # utils/jsonl for the tear-tolerance story.
+        self._file = jsonl.open_line_atomic(os.path.join(log_dir, "metrics.jsonl"))
         if config:
             self._write_record({"_config": {k: str(v) for k, v in config.items()}})
 
     def _write_record(self, record: Dict[str, Any]):
-        self._file.write((json.dumps(record) + "\n").encode("utf-8"))
+        jsonl.write_record(self._file, record)
 
     def log(self, stats: Dict[str, Any], step: Optional[int] = None):
         if not self.enabled:

@@ -1,0 +1,257 @@
+"""Runtime dispatch/donation sanitizer, armed by ``TRLX_TPU_SANITIZE``.
+
+The static pass (trlx_tpu/analysis, GL001/GL002) proves the *lexical*
+discipline; this module checks the *dynamic* half at runtime when armed:
+
+    TRLX_TPU_SANITIZE=dispatch,donation python -m pytest tests/...
+
+- ``dispatch``: every registered jitted-program wrapper asserts dispatch-lock
+  ownership at call time whenever another ``trlx-*`` worker thread is alive
+  (the PR 5 hazard: two threads enqueueing programs concurrently interleave
+  per-device order and deadlock XLA's cross-program rendezvous). Violations
+  raise :class:`DispatchLockViolation` naming the program and thread instead
+  of hanging a fleet.
+- ``donation``: snapshot/donation handoff points mark donated pytrees
+  (:func:`mark_donated`); any later host read that flows through a
+  :func:`check_host_read` checkpoint raises :class:`DonatedBufferRead`
+  naming the donation site — instead of jax's anonymous
+  "Array has been deleted" somewhere downstream.
+
+Contract when the env var is unset: ZERO overhead and byte-identical
+behavior — :func:`make_dispatch_lock` returns a plain ``threading.RLock``,
+:func:`wrap_dispatch` returns the function object unchanged (identity), and
+the mark/check hooks return immediately on a single attribute test.
+
+stdlib-only imports: this module is imported by jax-heavy modules, never the
+other way around, so the analysis suite can exercise it without jax.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+ENV_VAR = "TRLX_TPU_SANITIZE"
+_VALID_MODES = ("dispatch", "donation")
+
+
+class SanitizeError(RuntimeError):
+    """Base class for sanitizer violations."""
+
+
+class DispatchLockViolation(SanitizeError):
+    """A jitted program was dispatched without holding the dispatch lock
+    while other trlx-* threads were alive."""
+
+
+class DonatedBufferRead(SanitizeError):
+    """A host read touched a buffer that was donated to a jitted program."""
+
+
+def _parse_modes(raw: Optional[str]) -> frozenset:
+    if not raw:
+        return frozenset()
+    modes = {m.strip() for m in raw.split(",") if m.strip()}
+    unknown = modes - set(_VALID_MODES)
+    if unknown:
+        raise ValueError(
+            f"{ENV_VAR} has unknown mode(s) {sorted(unknown)}; "
+            f"valid: {','.join(_VALID_MODES)}"
+        )
+    return frozenset(modes)
+
+
+_MODES = _parse_modes(os.environ.get(ENV_VAR))
+
+
+def refresh() -> frozenset:
+    """Re-read ``TRLX_TPU_SANITIZE`` (tests toggle the env mid-process;
+    trainers/engines call this implicitly via make_dispatch_lock)."""
+    global _MODES
+    _MODES = _parse_modes(os.environ.get(ENV_VAR))
+    return _MODES
+
+
+def armed(mode: str) -> bool:
+    return mode in _MODES
+
+
+# --------------------------------------------------------------------------
+# dispatch mode
+# --------------------------------------------------------------------------
+
+
+class SanitizedDispatchLock:
+    """An RLock that knows its owner, so dispatch wrappers can assert
+    ownership. Context-manager compatible with threading.RLock (the only
+    protocol the dispatch sites use)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def __enter__(self) -> "SanitizedDispatchLock":
+        self._lock.acquire()
+        self._owner = threading.get_ident()
+        self._depth += 1
+        return self
+
+    def __exit__(self, *exc_info) -> bool:
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+        self._lock.release()
+        return False
+
+    # RLock API compatibility for non-context callers.
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._owner = threading.get_ident()
+            self._depth += 1
+        return ok
+
+    def release(self) -> None:
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+        self._lock.release()
+
+    def owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+
+def make_dispatch_lock():
+    """The trainer/engine dispatch-lock factory. Unarmed: a plain
+    threading.RLock — the serial path is byte-identical. Armed with
+    ``dispatch``: an ownership-tracking lock the wrappers can interrogate."""
+    refresh()
+    if armed("dispatch"):
+        return SanitizedDispatchLock()
+    return threading.RLock()
+
+
+def _other_trlx_thread_alive() -> bool:
+    """The PR 5 hazard predicate: is any OTHER thread that participates in
+    the trlx dispatch machinery alive? Worker threads are all named
+    ``trlx-*`` (rollout-producer, score-worker, prefetch, heartbeat, ...);
+    from a worker's point of view the main thread is always the other
+    dispatcher."""
+    cur = threading.current_thread()
+    if cur.name.startswith("trlx-"):
+        return True  # the main thread exists and dispatches
+    return any(
+        t.name.startswith("trlx-") and t.is_alive() and t is not cur
+        for t in threading.enumerate()
+    )
+
+
+def wrap_dispatch(name: str, fn, lock):
+    """Wrap a jitted-program wrapper with the dispatch-ownership assertion.
+
+    Identity unless ``lock`` is a :class:`SanitizedDispatchLock` (i.e. the
+    sanitizer was armed when the lock was built) — callers can wrap
+    unconditionally and pay nothing when unarmed."""
+    if not isinstance(lock, SanitizedDispatchLock):
+        return fn
+
+    def checked(*args, **kwargs):
+        if not lock.owned() and _other_trlx_thread_alive():
+            raise DispatchLockViolation(
+                f"jitted program {name!r} dispatched from thread "
+                f"{threading.current_thread().name!r} without holding the "
+                "dispatch lock while other trlx-* threads are alive; "
+                "concurrent dispatch interleaves per-device enqueue order "
+                "and can deadlock XLA collectives (see RUNBOOK §11 / GL001)"
+            )
+        return fn(*args, **kwargs)
+
+    checked.__name__ = f"sanitized_{name.replace('/', '_')}"
+    checked.__wrapped__ = fn
+    return checked
+
+
+# --------------------------------------------------------------------------
+# donation mode
+# --------------------------------------------------------------------------
+
+# id(buffer) → (buffer, site). Strong refs are cheap: donated buffers are
+# already deleted on device, only the small host handle stays alive — and the
+# strong ref is what makes the id() key collision-free.
+_DONATED: "OrderedDict[int, Tuple[Any, str]]" = OrderedDict()
+_DONATED_CAP = 4096
+_DONATED_LOCK = threading.Lock()
+
+
+def _iter_leaves(tree: Any) -> Iterator[Any]:
+    """Generic pytree-ish walk without importing jax: dicts (incl. flax
+    FrozenDict — it is a Mapping), sequences, and flax struct dataclasses."""
+    if tree is None:
+        return
+    if isinstance(tree, (list, tuple)):
+        for item in tree:
+            yield from _iter_leaves(item)
+        return
+    if hasattr(tree, "items"):
+        try:
+            for _, v in tree.items():
+                yield from _iter_leaves(v)
+            return
+        except TypeError:
+            pass
+    fields = getattr(tree, "__dataclass_fields__", None)
+    if fields:
+        for f in fields:
+            yield from _iter_leaves(getattr(tree, f, None))
+        return
+    yield tree
+
+
+def _is_buffer(leaf: Any) -> bool:
+    return hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+
+
+def mark_donated(tree: Any, site: str) -> None:
+    """Record every array leaf of ``tree`` as donated at ``site``. No-op
+    unless donation mode is armed. Call it with the PRE-dispatch reference
+    right after a donating dispatch returns."""
+    if "donation" not in _MODES:
+        return
+    with _DONATED_LOCK:
+        for leaf in _iter_leaves(tree):
+            if _is_buffer(leaf):
+                _DONATED[id(leaf)] = (leaf, site)
+        while len(_DONATED) > _DONATED_CAP:
+            _DONATED.popitem(last=False)
+
+
+def check_host_read(tree: Any, context: str) -> None:
+    """Raise :class:`DonatedBufferRead` if any array leaf of ``tree`` was
+    previously marked donated. No-op unless donation mode is armed. Wired at
+    host-read checkpoints (to_local_host, engine.update_weights, snapshot
+    paths)."""
+    if "donation" not in _MODES:
+        return
+    for leaf in _iter_leaves(tree):
+        if not _is_buffer(leaf):
+            continue
+        with _DONATED_LOCK:
+            hit = _DONATED.get(id(leaf))
+        if hit is not None and hit[0] is leaf:
+            raise DonatedBufferRead(
+                f"{context} reads a buffer (shape={getattr(leaf, 'shape', '?')}, "
+                f"dtype={getattr(leaf, 'dtype', '?')}) that was donated at "
+                f"{hit[1]!r}; donated buffers are deleted at dispatch — use "
+                "the post-dispatch result or snapshot before dispatch "
+                "(see RUNBOOK §11 / GL002)"
+            )
+
+
+def clear_donated() -> None:
+    """Drop all donation records (tests; also useful after a rollback
+    rebuilds the train state wholesale)."""
+    with _DONATED_LOCK:
+        _DONATED.clear()
