@@ -255,11 +255,26 @@ def _setup_compile_cache():
 
 OOM_EXIT_CODE = 77
 
+# Crash-proof run forensics (graftscope.RunManifest): the manifest is opened
+# at the top of main() and every heartbeat / child rc / partial result is one
+# line-atomic append, so a `timeout -k`-killed bench (the BENCH_r04/r05
+# shapes) still leaves a parseable journal bench_trajectory.py can turn into
+# a reason string. Module-global so the __main__ crash handler can close it.
+_MANIFEST = None
+
 
 def main():
+    global _MANIFEST
     import jax
 
+    from trlx_tpu.observability.graftscope import MANIFEST_FILENAME, RunManifest
+
     _setup_compile_cache()
+    manifest = _MANIFEST = RunManifest(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), MANIFEST_FILENAME),
+        cmd=" ".join(sys.argv),
+        backend=jax.default_backend(),
+    )
 
     preset = os.environ.get("BENCH_PRESET", "auto")
     fp32_point = os.environ.get("BENCH_FP32_POINT", "1") == "1"
@@ -304,6 +319,7 @@ def main():
         failed_candidates.append(
             {"candidate": cand[0], "rc": rc, "tail": tail[-2000:] if tail else ""}
         )
+        manifest.child(cand[0], rc, tail or "")
         print(
             f"bench: {cand[0]} failed (rc={rc}); recorded, trying next size",
             file=sys.stderr,
@@ -345,6 +361,7 @@ def main():
             # OOM exit, or the runtime hard-aborted the child (SIGABRT from a
             # native allocator failure never reaches the Python handler) —
             # either way this size doesn't fit; keep the attempt debuggable.
+            manifest.child(cand[0], proc.returncode, proc.stderr)
             sys.stderr.write(proc.stderr[-1500:])
             return None
         if proc.returncode != 0:
@@ -412,6 +429,11 @@ def main():
 
     def first_fitting(cands, **kwargs):
         for cand in cands:
+            # Journal BEFORE launching: a hard kill mid-candidate leaves
+            # this heartbeat as the manifest's "died during X" evidence.
+            manifest.heartbeat(
+                "size_ladder", candidate=cand[0], mode=kwargs.get("mode", "ppo")
+            )
             result = try_one(cand, **kwargs)
             if result is None:
                 print(f"bench: {cand[0]} did not complete, trying next size", file=sys.stderr)
@@ -468,10 +490,16 @@ def main():
         detail = "; ".join(
             f"{f['candidate']} rc={f['rc']}" for f in failed_candidates
         )
-        raise RuntimeError(
-            "no bench size fit the device"
-            + (f" (non-OOM failures: {detail})" if detail else "")
+        msg = "no bench size fit the device" + (
+            f" (non-OOM failures: {detail})" if detail else ""
         )
+        manifest.finish(rc=1, reason=msg)
+        raise RuntimeError(msg)
+    # The flagship number exists from here on: journal it immediately so a
+    # kill during the OPTIONAL points (fp32/ILQL) cannot lose it.
+    manifest.partial(
+        {k: result.get(k) for k in ("metric", "value", "unit", "size") if k in result}
+    )
     if failed_candidates:
         # Published alongside the flagship number: which larger sizes failed
         # for non-OOM reasons, with the stderr tail for triage.
@@ -490,6 +518,7 @@ def main():
 
     if fp32_candidates and fp32_point and _optional_budget_left("fp32 point"):
         gc.collect()
+        manifest.heartbeat("fp32_point")
         fp32 = _optional_point(
             "fp32 point", lambda: first_fitting(fp32_candidates, iters=2, orchestrator=False)
         )
@@ -512,6 +541,7 @@ def main():
     # size may be smaller — the same OOM-fallback machinery sizes it.
     if os.environ.get("BENCH_ILQL_POINT", "1") == "1" and _optional_budget_left("ILQL point"):
         gc.collect()
+        manifest.heartbeat("ilql_point")
         ilql_candidates = ILQL_SIZES if preset == "auto" else [ILQL_SIZES[-1]]
         if jax.default_backend() != "tpu":
             ilql_candidates = [ILQL_SIZES[-1]]
@@ -579,6 +609,7 @@ def main():
         except (KeyError, ValueError, TypeError) as e:
             print(f"bench: HEADTOHEAD.json unreadable ({e}); vs_baseline stays null", file=sys.stderr)
     print(json.dumps(result))
+    manifest.finish(rc=0, metric=result.get("metric"), value=result.get("value"))
 
 
 def device_sync(tree):
@@ -1156,4 +1187,13 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         _main_one(sys.argv[2])
         sys.exit(0)
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BaseException as e:
+        # SystemExit(0) falls through finish() above; anything else gets a
+        # forensic end record (finish() is idempotent, so a reason already
+        # journaled — e.g. "no bench size fit" — stands). A SIGKILL never
+        # reaches here, which is exactly what the heartbeat trail is for.
+        if _MANIFEST is not None and not isinstance(e, SystemExit):
+            _MANIFEST.finish(rc=1, reason=f"{type(e).__name__}: {str(e)[:300]}")
+        raise

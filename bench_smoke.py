@@ -430,18 +430,30 @@ def _decode_engine_probe_meshless():
 
 
 def main():
+    from trlx_tpu.observability.graftscope import RunManifest
+
     t0 = time.time()
-    result = {
-        "kernel": kernel_probe(),
-        "rollout": rollout_probe(),
-        "overlap": overlap_probe(),
-        "fused_loss": fused_loss_probe(),
-        "decode_engine": decode_engine_probe(),
-    }
+    # Same crash contract as bench.py: a killed smoke run leaves a
+    # line-atomic journal saying which probe it died in.
+    manifest = RunManifest(
+        os.path.join(REPO, "BENCH_SMOKE_MANIFEST.jsonl"), cmd=" ".join(sys.argv)
+    )
+    result = {}
+    for name, probe in (
+        ("kernel", kernel_probe),
+        ("rollout", rollout_probe),
+        ("overlap", overlap_probe),
+        ("fused_loss", fused_loss_probe),
+        ("decode_engine", decode_engine_probe),
+    ):
+        manifest.heartbeat("probe", candidate=name)
+        result[name] = probe()
+        manifest.partial(result)
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps({"smoke": "ok", **result}))
+    manifest.finish(rc=0)
 
 
 if __name__ == "__main__":

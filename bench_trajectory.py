@@ -15,6 +15,11 @@ Inputs (all already tracked in the repo root):
   (rollout/fused-loss tokens/s, overlap fraction). Folded into the series
   for trend reading, never gated: CPU smoke numbers measure the harness,
   not the hardware.
+- ``BENCH_MANIFEST.jsonl`` / ``BENCH_MANIFEST_rNN.jsonl`` — bench.py's
+  crash-proof RunManifest journal (observability/graftscope). For runs
+  whose artifact carries no data, the manifest's forensic reason (which
+  phase/candidate the run was killed in, the last child failure's rc and
+  stderr tail) replaces the generic ``no_data`` reason.
 
 Output: ``BENCH_TRAJECTORY.json`` — the full series plus the gate verdict.
 
@@ -30,11 +35,105 @@ the CI job needs no installs.
 import argparse
 import glob
 import json
+import os
 import re
 import sys
 
 RUN_GLOB = "BENCH_r[0-9]*.json"
 SMOKE_PATH = "BENCH_SMOKE.json"
+MANIFEST_PATH = "BENCH_MANIFEST.jsonl"
+
+
+def _read_manifest(path: str):
+    """Inline stdlib mirror of observability/graftscope.RunManifest.read —
+    this script must stay import-light (the CI job installs nothing), so it
+    cannot import the observability package. tests/test_observability.py
+    asserts the two produce the same summary, so they cannot drift.
+
+    Folds a possibly-torn, possibly end-less line-atomic manifest into
+    ``{"valid", "complete", "rc", "reason", "last_heartbeat", "partial"}``.
+    """
+    try:
+        with open(path, "rb") as f:
+            lines = f.read().split(b"\n")
+    except OSError:
+        return None
+    records = []
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            continue  # torn tail (SIGKILL mid-append) — every prior line counts
+    begin = next((r for r in records if r.get("event") == "begin"), None)
+    if begin is None:
+        return None
+    end = next((r for r in reversed(records) if r.get("event") == "end"), None)
+    heartbeats = [r for r in records if r.get("event") == "heartbeat"]
+    children = [r for r in records if r.get("event") == "child"]
+    partial = next(
+        (r.get("metrics") for r in reversed(records) if r.get("event") == "partial"),
+        None,
+    )
+    if end is not None:
+        reason = end.get("reason") or f"completed rc={end.get('rc')}"
+        rc = end.get("rc")
+    else:
+        rc = None
+        if heartbeats:
+            last = heartbeats[-1]
+            where = last.get("phase", "?")
+            cand = last.get("candidate")
+            reason = f"run killed mid-flight during {where}" + (
+                f" (candidate {cand})" if cand else ""
+            )
+        else:
+            reason = "run killed before first heartbeat"
+        failed = [c for c in children if c.get("rc") not in (0, None)]
+        if failed:
+            tail = (failed[-1].get("stderr_tail") or "").strip().splitlines()
+            last_line = tail[-1][:160] if tail else ""
+            reason += (
+                f"; last child failure {failed[-1].get('label')} "
+                f"rc={failed[-1].get('rc')}"
+            ) + (f": {last_line}" if last_line else "")
+    return {
+        "valid": True,
+        "complete": end is not None,
+        "rc": rc,
+        "reason": reason,
+        "last_heartbeat": heartbeats[-1] if heartbeats else None,
+        "partial": partial,
+    }
+
+
+def _attach_manifest_reasons(runs, manifest_path=MANIFEST_PATH):
+    """For no-data runs, surface the RunManifest's forensic reason instead
+    of the generic artifact-side one. A per-run ``BENCH_MANIFEST_rNN.jsonl``
+    beside the artifact wins; the shared ``BENCH_MANIFEST.jsonl`` (bench.py
+    truncates it per run, so it describes ONE run) applies only to the
+    latest artifact — attributing it to an older gap would be a lie."""
+    for i, entry in enumerate(runs):
+        if not entry.get("no_data") and "error" not in entry:
+            continue
+        summary = None
+        if entry.get("run") is not None:
+            per_run = os.path.join(
+                os.path.dirname(entry["source"]) or ".",
+                f"BENCH_MANIFEST_r{entry['run']:02d}.jsonl",
+            )
+            summary = _read_manifest(per_run)
+        if summary is None and i == len(runs) - 1:
+            summary = _read_manifest(manifest_path)
+        if summary is None or (summary["complete"] and summary.get("rc") == 0):
+            # A clean-finish manifest can't explain a no-data artifact —
+            # keep the artifact-side reason.
+            continue
+        entry["reason"] = summary["reason"]
+        entry["manifest"] = True
+        if summary.get("partial"):
+            entry["manifest_partial"] = summary["partial"]
 
 
 def _parse_run(path: str):
@@ -46,7 +145,8 @@ def _parse_run(path: str):
             run = json.load(f)
     except (OSError, ValueError) as e:
         return {"source": path, "error": f"{type(e).__name__}: {e}"}
-    m = re.search(r"r(\d+)", path)
+    # basename only: a directory component like /tmp/xyr42/ must not win
+    m = re.search(r"r(\d+)", os.path.basename(path))
     entry = {"source": path, "run": int(m.group(1)) if m else None, "rc": run.get("rc")}
     parsed = run.get("parsed")
     tail_error = None
@@ -110,8 +210,12 @@ def _parse_smoke(path: str):
     return out
 
 
-def build_trajectory(run_paths, smoke_path=SMOKE_PATH, tolerance: float = 0.10):
+def build_trajectory(
+    run_paths, smoke_path=SMOKE_PATH, tolerance: float = 0.10,
+    manifest_path=MANIFEST_PATH,
+):
     runs = [_parse_run(p) for p in sorted(run_paths)]
+    _attach_manifest_reasons(runs, manifest_path=manifest_path)
     with_data = [r for r in runs if "samples_per_sec_per_chip" in r]
     trajectory = {
         "runs": runs,

@@ -252,9 +252,182 @@ def observability_probe():
     }
 
 
+def graftscope_probe():
+    """PR 12 smoke: an armed overlapped+engine run must produce the
+    conservation ledger, a bubble fraction, slot-timeline rows, and /metrics
+    histograms — and a SIGKILLed bench child must still leave a RunManifest
+    that bench_trajectory turns into a reason instead of ``no_data``."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    # The first probe's drills must not pollute this run's timings.
+    os.environ.pop("TRLX_TPU_FAULTS", None)
+    os.environ.pop("TRLX_TPU_SLOW_STEP_SECONDS", None)
+    os.environ["TRLX_TPU_PEAK_TFLOPS"] = "0.01"
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    import trlx_tpu
+    from randomwalks import base_config, generate_random_walks
+    from trlx_tpu.observability import spans
+    from trlx_tpu.observability.graftscope import RunManifest
+
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.graftscope = True  # implies spans + device telemetry
+    port = _free_port()
+    config.train.metrics_port = port
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 8
+    config.method.max_staleness = 1
+    config.method.rollout_engine = True
+    config.method.engine_slots = 4
+    config.method.prefill_batch = 2
+    d = tempfile.mkdtemp(prefix="obs_smoke_gs_")
+    config.train.checkpoint_dir = d
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    scraper = _Scraper(port)
+    t0 = time.time()
+    try:
+        model = trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=[[1]],
+            metric_fn=metric_fn,
+            config=config,
+            logit_mask=logit_mask,
+        )
+    finally:
+        wall_s = time.time() - t0
+        scraper.stop()
+    assert model.iter_count >= 8
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("trlx-")]
+    assert not leaked, f"threads leaked (graftscope drain?): {leaked}"
+
+    # --- conservation ledger in metrics.jsonl -----------------------------
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    windows = [r for r in records if "obs/ledger_wall_s" in r]
+    assert windows, "no ledger windows in metrics.jsonl"
+    for r in windows:
+        wall = r["obs/ledger_wall_s"]
+        err = abs(
+            r["obs/ledger_device_busy_s"]
+            + r["obs/ledger_host_s"]
+            + r["obs/ledger_bubble_s"]
+            - wall
+        ) / max(wall, 1e-9)
+        assert err <= 0.05, f"ledger conservation violated: {err:.4f} in {r}"
+        assert 0.0 <= r["obs/bubble_fraction"] <= 1.0
+    assert any(r["obs/ledger_device_busy_s"] > 0 for r in windows), (
+        "fence drain attributed zero device time across every window"
+    )
+
+    # --- slot timeline in spans.jsonl + snapshot rollups ------------------
+    events = spans.read_spans(os.path.join(d, spans.SPANS_FILENAME))
+    slot_spans = [e for e in events if e.get("name") == "engine/slot"]
+    admits = [e for e in events if e.get("name") == "engine/slot/admit"]
+    harvests = [e for e in events if e.get("name") == "engine/slot/harvest"]
+    assert slot_spans and admits and harvests, (
+        f"slot timeline missing: {len(slot_spans)} spans, {len(admits)} admits, "
+        f"{len(harvests)} harvests"
+    )
+    gs_path = os.path.join(d, "graftscope.json")
+    with open(gs_path) as f:
+        snap = json.load(f)
+    assert snap["windows"], "graftscope.json has no windows"
+    assert snap["slots"] and all(row["episodes"] > 0 for row in snap["slots"]), (
+        f"slot occupancy rows missing/empty: {snap.get('slots')}"
+    )
+    with open(os.path.join(REPO, "OBS_GRAFTSCOPE.json"), "w") as f:
+        json.dump(snap, f, indent=1)
+
+    # --- /metrics histograms (lane gaps at minimum) -----------------------
+    prom = scraper.metrics_text
+    assert "trlx_tpu_obs_lane_gap_s_bucket" in prom, prom[:2000]
+    assert "trlx_tpu_obs_bubble_fraction" in prom
+
+    # --- forced-kill bench child → valid manifest with a reason -----------
+    mdir = tempfile.mkdtemp(prefix="obs_smoke_manifest_")
+    mpath = os.path.join(mdir, "BENCH_MANIFEST_r99.jsonl")
+    child_src = (
+        "import os, sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from trlx_tpu.observability.graftscope import RunManifest\n"
+        "m = RunManifest(%r, cmd='bench.py (smoke drill)')\n"
+        "m.heartbeat('size_ladder', candidate='gptj-l8-d4096-2.0B-w8-bf16')\n"
+        "m.child('gptj-l8-d4096-2.0B-w8-bf16', 1, 'ValueError: mosaic lowering failed')\n"
+        "m.heartbeat('size_ladder', candidate='gptj-l6-d2048-0.4B-w8-bf16')\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n"
+    ) % (REPO, mpath)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        stdout=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.stdout.readline().strip() == "ready"
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    summary = RunManifest.read(mpath)
+    assert summary["valid"] and not summary["complete"], summary
+    assert "killed mid-flight during size_ladder" in summary["reason"], summary
+    assert "rc=1" in summary["reason"], summary
+
+    # --- bench_trajectory ingests the manifest reason ---------------------
+    import bench_trajectory
+
+    art = os.path.join(mdir, "BENCH_r99.json")
+    with open(art, "w") as f:
+        json.dump({"n": 99, "cmd": "timeout -k 10 900 python bench.py", "rc": 124, "tail": ""}, f)
+    traj = bench_trajectory.build_trajectory(
+        [art], smoke_path=os.path.join(mdir, "missing.json"),
+        manifest_path=os.path.join(mdir, "missing.jsonl"),
+    )
+    entry = traj["runs"][0]
+    assert entry.get("no_data") and entry.get("manifest"), entry
+    assert entry["reason"] == summary["reason"], (entry["reason"], summary["reason"])
+
+    return {
+        "steps": model.iter_count,
+        "ledger_windows": len(windows),
+        "worst_conservation_error": round(
+            max(
+                abs(
+                    r["obs/ledger_device_busy_s"]
+                    + r["obs/ledger_host_s"]
+                    + r["obs/ledger_bubble_s"]
+                    - r["obs/ledger_wall_s"]
+                )
+                / max(r["obs/ledger_wall_s"], 1e-9)
+                for r in windows
+            ),
+            6,
+        ),
+        "bubble_fraction_last": round(windows[-1]["obs/bubble_fraction"], 4),
+        "slot_spans": len(slot_spans),
+        "slot_admits": len(admits),
+        "snapshot_slots": len(snap["slots"]),
+        "killed_manifest_reason": summary["reason"],
+        "seconds": round(wall_s, 2),
+    }
+
+
 def main():
     t0 = time.time()
     result = {"observability": observability_probe()}
+    result["graftscope"] = graftscope_probe()
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)

@@ -400,6 +400,39 @@ def test_exporter_update_merges_and_collisions_keep_last_writer():
         ex.close()
 
 
+def test_exporter_histogram_exposition_cumulative_with_labels():
+    """graftscope's distribution feeds (lane gaps, refill waits, straggler
+    steps) render as conformant Prometheus histograms: cumulative buckets,
+    an explicit +Inf bucket, _sum/_count, labels splitting series under one
+    metric name, NaN samples dropped."""
+    ex = MetricsExporter(port=0)
+    try:
+        ex.observe("obs/lane_gap_s", [0.004, 0.004, 0.8, float("nan")],
+                   buckets=(0.005, 0.1, 1.0), labels={"lane": "score"})
+        ex.observe("obs/lane_gap_s", [2.5], buckets=(0.005, 0.1, 1.0),
+                   labels={"lane": "producer"})
+        ex.observe("engine/refill_wait_ms", [3.0, 40.0], buckets=(5.0, 50.0))
+        ex.observe("engine/refill_wait_ms", [4.0], buckets=(5.0, 50.0))  # folds
+        _, body = _get(ex.port, "/metrics")
+        assert "# TYPE trlx_tpu_obs_lane_gap_s histogram" in body
+        assert 'trlx_tpu_obs_lane_gap_s_bucket{lane="score",le="0.005"} 2' in body
+        assert 'trlx_tpu_obs_lane_gap_s_bucket{lane="score",le="1.0"} 3' in body
+        assert 'trlx_tpu_obs_lane_gap_s_bucket{lane="score",le="+Inf"} 3' in body
+        assert 'trlx_tpu_obs_lane_gap_s_count{lane="score"} 3' in body  # NaN gone
+        assert 'trlx_tpu_obs_lane_gap_s_bucket{lane="producer",le="1.0"} 0' in body
+        assert 'trlx_tpu_obs_lane_gap_s_bucket{lane="producer",le="+Inf"} 1' in body
+        assert 'trlx_tpu_engine_refill_wait_ms_bucket{le="5.0"} 2' in body
+        assert 'trlx_tpu_engine_refill_wait_ms_bucket{le="+Inf"} 3' in body
+        assert "trlx_tpu_engine_refill_wait_ms_sum 47.0" in body
+        assert "trlx_tpu_engine_refill_wait_ms_count 3" in body
+        # every non-comment line still carries a legal metric name
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                assert _VALID.match(line.split("{")[0].split()[0]), line
+    finally:
+        ex.close()
+
+
 # ------------------------------------------------------------ e2e acceptance
 
 

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.models.lm import init_cache
+from trlx_tpu.observability import graftscope
+from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.observability.spans import trace_span
 from trlx_tpu.ops.sampling import GenerateConfig, process_logits_default
 from trlx_tpu.pipeline.prompt_pipeline import PromptSlotQueue
@@ -119,6 +121,11 @@ class RolloutEngine:
         self.queue = PromptSlotQueue()
         self._slot_meta = [None] * self.n_slots  # per-occupied-slot host facts
         self._free = list(range(self.n_slots))
+        # graftscope slot timeline: wall clock when each slot was last
+        # harvested (None until then) — the refill-wait numerator. Only
+        # touched when the scope is armed, so the unarmed path stays
+        # byte-identical.
+        self._slot_free_t = [None] * self.n_slots
         self._variables = None
         self.weight_version = None
         self._state = None
@@ -241,9 +248,28 @@ class RolloutEngine:
         if done:
             toks = np.asarray(jax.device_get(self._state["tokens"]), dtype=np.int32)
             R = int(self.gcfg.max_new_tokens)
+            scope = graftscope.scope()
             for i in done:
                 meta, self._slot_meta[i] = self._slot_meta[i], None
                 steps = int(n_gen[i])
+                if scope is not None:
+                    # Slot-timeline harvest (host side only — GL003 keeps
+                    # clock reads out of the traced decode body): one
+                    # "engine/slot" span covering the admit→harvest life of
+                    # this episode, a harvest instant, and the straggler
+                    # sample (bucket width → decode steps) for the ledger.
+                    now = time.time()
+                    self._slot_free_t[i] = now
+                    admit_t = meta.get("admit_t")
+                    width = int(meta.get("width", len(meta["prompt_ids"])))
+                    if admit_t is not None:
+                        obs_spans.complete(
+                            "engine/slot", admit_t, slot=i, width=width, steps=steps
+                        )
+                    obs_spans.instant("engine/slot/harvest", slot=i, steps=steps)
+                    scope.record_harvest(
+                        i, width, steps, (now - admit_t) if admit_t is not None else 0.0
+                    )
                 rmask = np.zeros((R,), dtype=np.int32)
                 rmask[:steps] = 1
                 episodes.append(
@@ -293,12 +319,33 @@ class RolloutEngine:
                 sanitize.mark_donated(prev_state, "engine._prefill(state) [admit]")
                 del prev_state
             self._prefill_wall += time.time() - t0
+            scope = graftscope.scope()
             for row, slot in enumerate(slots):
                 self._slot_meta[int(slot)] = {
                     "prompt_ids": ids[row],
                     "prompt_mask": msk[row],
                     "version": self.weight_version,
                 }
+                if scope is not None:
+                    # Slot-timeline admit: t0 (captured before the prefill
+                    # dispatch) ends the slot's refill wait; the episode's
+                    # occupancy span starts here.
+                    j = int(slot)
+                    self._slot_meta[j]["admit_t"] = t0
+                    self._slot_meta[j]["width"] = int(width)
+                    freed = self._slot_free_t[j]
+                    wait_s = (t0 - freed) if freed is not None else None
+                    scope.record_refill(j, int(width), wait_s)
+                    obs_spans.instant(
+                        "engine/slot/admit",
+                        slot=j,
+                        width=int(width),
+                        **(
+                            {"wait_ms": round(wait_s * 1e3, 3)}
+                            if wait_s is not None
+                            else {}
+                        ),
+                    )
             self._prefill_calls += 1
             self._refills += int(ids.shape[0])
             admitted += int(ids.shape[0])
@@ -333,6 +380,7 @@ class RolloutEngine:
         self.queue.clear()
         self._slot_meta = [None] * self.n_slots
         self._free = list(range(self.n_slots))
+        self._slot_free_t = [None] * self.n_slots
         if self._state is not None:
             self._state = dict(
                 self._state, active=jnp.zeros((self.n_slots,), dtype=bool)

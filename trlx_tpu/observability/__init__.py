@@ -1,6 +1,7 @@
-"""Unified observability layer (PR 8) + training-health monitor (PR 9).
+"""Unified observability layer (PR 8) + training-health monitor (PR 9)
++ graftscope attribution ledger & run forensics (PR 12).
 
-Six parts, all off-hot-path and off by default:
+Seven parts, all off-hot-path and off by default:
 
 - ``spans``     — cross-thread Chrome-trace span tracing into
                   ``<ckpt_dir>/spans.jsonl`` (``train.trace_spans`` /
@@ -21,14 +22,21 @@ Six parts, all off-hot-path and off by default:
                   endpoint from process 0 (``train.metrics_port`` /
                   ``TRLX_TPU_METRICS_PORT``);
 - ``report``    — ``python -m trlx_tpu.observability.report <ckpt_dir>``
-                  renders everything as one markdown performance report.
+                  renders everything as one markdown performance report;
+- ``graftscope``— device-time attribution ledger (``device_busy + host +
+                  bubble == wall`` per phase window, per-program top-K),
+                  pipeline-bubble accounting with per-lane gap histograms,
+                  engine slot timeline, and the crash-proof ``RunManifest``
+                  bench forensics (``train.graftscope`` /
+                  ``TRLX_TPU_GRAFTSCOPE=1``).
 
-See RUNBOOK.md §8 (performance) and §9 (training health) for knobs and
-triage.
+See RUNBOOK.md §8 (performance), §9 (training health) and §12 (device-time
+attribution & run forensics) for knobs and triage.
 """
 
 import os
 
+from trlx_tpu.observability import graftscope  # noqa: F401 — canonical import point
 from trlx_tpu.observability import spans  # noqa: F401 — canonical import point
 from trlx_tpu.observability.anomaly import AnomalyDetector, IncidentCapture  # noqa: F401
 from trlx_tpu.observability.devicemon import DeviceMonitor  # noqa: F401

@@ -115,7 +115,16 @@ class _MonitoredFn:
 
     def __call__(self, *args, **kwargs):
         self._monitor._on_dispatch(self._name, self._fn, args, kwargs)
-        return self._fn(*args, **kwargs)
+        out = self._fn(*args, **kwargs)
+        ledger = self._monitor.ledger
+        if ledger is not None:
+            # graftscope device-time attribution: hand the async result to
+            # the ledger, whose drain THREAD takes the completion-fence
+            # timestamp — nothing blocks on the dispatch path.
+            ledger.track_dispatch(
+                self._name, self._monitor.programs[self._name]["phase"], out
+            )
+        return out
 
     def __getattr__(self, item):
         # Only reached for names not on the proxy — live delegation keeps
@@ -141,6 +150,9 @@ class DeviceMonitor:
         self._lock = threading.Lock()
         self._window_flops = {}  # phase -> flops dispatched since last window()
         self._dirty = False
+        # graftscope attribution ledger, attached by the trainer when armed;
+        # None keeps the dispatch path on one attribute load.
+        self.ledger = None
 
     def wrap(self, name, fn, phase: str = "train"):
         with self._lock:

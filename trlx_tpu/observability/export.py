@@ -13,6 +13,11 @@ overrides) and off by default:
 - ``GET /healthz`` — the HealthMonitor's JSON status
   (``ok`` / ``degraded`` / ``critical`` + per-detector states).
 
+Besides gauges, :meth:`MetricsExporter.observe` accumulates cumulative
+Prometheus histograms (``_bucket{le=...}`` / ``_sum`` / ``_count``) with
+optional labels — graftscope feeds per-lane pipeline-gap, engine
+refill-latency, and straggler-by-width distributions through it.
+
 Multi-host: the trainer rolls the gauges up over the existing
 ``allgather_host`` path (``rollup_window_stats``) BEFORE handing them over,
 so process 0 serves fleet-level ``/hostmean`` / ``/hostmax`` views, not its
@@ -69,6 +74,9 @@ class MetricsExporter:
         self.prefix = prefix
         self._lock = threading.Lock()
         self._gauges = {}
+        # (key, labels-tuple) -> {"buckets": (edges...), "counts": [..],
+        # "sum": float, "count": int} — cumulative, Prometheus-style.
+        self._histograms = {}
         self._health = None
         self._step = 0
         exporter = self
@@ -118,9 +126,54 @@ class MetricsExporter:
             if health is not None:
                 self._health = health
 
+    def observe(self, key: str, values, buckets, labels: dict = None):
+        """Fold ``values`` into the cumulative histogram ``key`` (creating
+        it with ``buckets`` as its ``le`` edges on first sight). ``labels``
+        distinguishes series under one metric name (``lane="score"``,
+        ``width="64"``) the Prometheus way."""
+        label_key = tuple(sorted((labels or {}).items()))
+        edges = tuple(float(b) for b in buckets)
+        with self._lock:
+            hist = self._histograms.get((key, label_key))
+            if hist is None or hist["buckets"] != edges:
+                hist = self._histograms[(key, label_key)] = {
+                    "buckets": edges,
+                    "counts": [0] * (len(edges) + 1),  # +Inf bucket last
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            for v in values:
+                v = float(v)
+                if v != v:
+                    continue
+                idx = len(edges)
+                for i, edge in enumerate(edges):
+                    if v <= edge:
+                        idx = i
+                        break
+                hist["counts"][idx] += 1
+                hist["sum"] += v
+                hist["count"] += 1
+
+    @staticmethod
+    def _render_labels(label_key, extra=None):
+        pairs = list(label_key) + (extra or [])
+        if not pairs:
+            return ""
+        return "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+
     def render_metrics(self) -> str:
         with self._lock:
             gauges = dict(self._gauges)
+            histograms = {
+                k: {
+                    "buckets": h["buckets"],
+                    "counts": list(h["counts"]),
+                    "sum": h["sum"],
+                    "count": h["count"],
+                }
+                for k, h in self._histograms.items()
+            }
             step = self._step
         # Sanitized-name collisions (a/b vs a_b) keep the last writer —
         # exposition must never emit a duplicate metric name.
@@ -134,6 +187,26 @@ class MetricsExporter:
             lines.append(f"# HELP {name} trlx_tpu tracker key {key!r}")
             lines.append(f"# TYPE {name} {kind}")
             lines.append(f"{name} {_fmt_value(value)}")
+        hist_by_name = {}
+        for (key, label_key), hist in sorted(histograms.items()):
+            hist_by_name.setdefault(
+                sanitize_metric_name(self.prefix + key), (key, [])
+            )[1].append((label_key, hist))
+        for name in sorted(hist_by_name):
+            key, series = hist_by_name[name]
+            lines.append(f"# HELP {name} trlx_tpu tracker key {key!r}")
+            lines.append(f"# TYPE {name} histogram")
+            for label_key, hist in series:
+                cumulative = 0
+                for edge, n in zip(hist["buckets"], hist["counts"]):
+                    cumulative += n
+                    labels = self._render_labels(label_key, [("le", _fmt_value(edge))])
+                    lines.append(f"{name}_bucket{labels} {cumulative}")
+                labels = self._render_labels(label_key, [("le", "+Inf")])
+                lines.append(f"{name}_bucket{labels} {hist['count']}")
+                labels = self._render_labels(label_key)
+                lines.append(f"{name}_sum{labels} {_fmt_value(hist['sum'])}")
+                lines.append(f"{name}_count{labels} {hist['count']}")
         name = sanitize_metric_name(self.prefix + "last_step")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {step}")

@@ -38,6 +38,7 @@ from transformers import AutoTokenizer
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import trainable_mask
 from trlx_tpu import observability as obs
+from trlx_tpu.observability import graftscope as obs_graftscope
 from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
 from trlx_tpu.parallel.mesh import DATA_AXES, barrier, init_distributed, is_main_process
@@ -241,7 +242,11 @@ class JaxBaseTrainer(BaseRLTrainer):
         # can be bolted onto any run command; everything defaults OFF and the
         # instrumentation stays off the hot dispatch path.
         ckpt_dir = os.path.abspath(config.train.checkpoint_dir)
-        if config.train.trace_spans or obs.env_flag("TRLX_TPU_SPANS"):
+        # graftscope (attribution ledger + bubble accounting + slot
+        # timeline) needs both the fence hook in DeviceMonitor and the spans
+        # file for its timeline rows, so arming it implies arming those two.
+        graftscope_on = config.train.graftscope or obs.env_flag("TRLX_TPU_GRAFTSCOPE")
+        if config.train.trace_spans or graftscope_on or obs.env_flag("TRLX_TPU_SPANS"):
             obs_spans.configure(
                 os.path.join(ckpt_dir, obs_spans.SPANS_FILENAME),
                 process_index=jax.process_index(),
@@ -252,12 +257,29 @@ class JaxBaseTrainer(BaseRLTrainer):
             # appending this run's thread spans to its old file.
             obs_spans.shutdown()
         self._devicemon = None
-        if config.train.device_telemetry or obs.env_flag("TRLX_TPU_DEVICE_TELEMETRY"):
+        if (
+            config.train.device_telemetry
+            or graftscope_on
+            or obs.env_flag("TRLX_TPU_DEVICE_TELEMETRY")
+        ):
             self._devicemon = obs.DeviceMonitor(
                 programs_path=(
                     os.path.join(ckpt_dir, "programs.json") if is_main_process() else None
                 )
             )
+        self._graftscope = None
+        if graftscope_on:
+            self._graftscope = obs_graftscope.configure(
+                os.path.join(ckpt_dir, obs_graftscope.SNAPSHOT_FILENAME)
+                if is_main_process()
+                else None
+            )
+            self._devicemon.ledger = self._graftscope
+        else:
+            # Same ownership rule as the span tracer above: a prior armed
+            # trainer in this process must not keep its drain thread and
+            # ledger alive into this run.
+            obs_graftscope.shutdown()
         anomaly_factor = float(
             os.environ.get("TRLX_TPU_ANOMALY_FACTOR", "") or config.train.anomaly_factor
         )
@@ -432,7 +454,56 @@ class JaxBaseTrainer(BaseRLTrainer):
         out = monitor.window(phase_seconds)
         out.update(monitor.kernel_routing_gauges())
         out.update(monitor.device_memory_gauges())
+        gs = getattr(self, "_graftscope", None)
+        if gs is not None:
+            out.update(gs.window())
+            self._flush_graftscope_samples(gs)
+            gs.flush()
         return out
+
+    def _flush_graftscope_samples(self, gs) -> None:
+        """Feed the window's raw graftscope samples (per-lane idle gaps,
+        engine refill waits, straggler steps per bucket width) to the
+        tracker's histogram records and, when serving, the /metrics
+        histograms."""
+        samples = gs.drain_samples()
+        if not samples:
+            return
+        exporter = getattr(self, "_metrics_exporter", None)
+        for lane, gaps in sorted(samples.get("lane_gaps", {}).items()):
+            if not gaps:
+                continue
+            self.tracker.log_histogram(
+                "obs/lane_gap_" + lane + "_s", gaps, step=self.iter_count
+            )
+            if exporter is not None:
+                exporter.observe(
+                    "obs/lane_gap_s",
+                    gaps,
+                    buckets=obs_graftscope.LANE_GAP_S_BUCKETS,
+                    labels={"lane": lane},
+                )
+        waits = samples.get("refill_wait_ms") or []
+        if waits:
+            self.tracker.log_histogram(
+                "engine/refill_wait_ms", waits, step=self.iter_count
+            )
+            if exporter is not None:
+                exporter.observe(
+                    "engine/refill_wait_ms",
+                    waits,
+                    buckets=obs_graftscope.REFILL_WAIT_MS_BUCKETS,
+                )
+        for width, steps in sorted((samples.get("straggler_steps") or {}).items()):
+            if not steps:
+                continue
+            if exporter is not None:
+                exporter.observe(
+                    "engine/straggler_steps",
+                    steps,
+                    buckets=obs_graftscope.STRAGGLER_STEPS_BUCKETS,
+                    labels={"width": str(width)},
+                )
 
     def build_trainable_mask(self, init_params):
         """Default layer-freezing mask (num_layers_unfrozen); subclasses
@@ -652,6 +723,8 @@ class JaxBaseTrainer(BaseRLTrainer):
                     merged.get("time/overlap_fraction", 0.0),
                 )
             )
+        if "obs/bubble_fraction" in merged:
+            parts.append("bub={:.0%}".format(merged["obs/bubble_fraction"]))
         # \x1b[K clears to end-of-line so a previous longer line (e.g. one
         # with eval-only keys) leaves no remnants after the rewrite.
         print("  ".join(parts) + "\x1b[K", end="\r", file=sys.stderr, flush=True)
@@ -884,6 +957,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                 # Final registry persist: dispatches since the last window
                 # boundary must still show in programs.json for the report.
                 self._devicemon.flush()
+            if self._graftscope is not None:
+                # Joins the fence-drain thread (obs_smoke asserts no trlx-*
+                # threads survive learn()) and writes the final snapshot.
+                self._devicemon.ledger = None
+                obs_graftscope.shutdown()
+                self._graftscope = None
             if self._metrics_exporter is not None:
                 # Exporter last: it only serves snapshots, so scrapers get
                 # the final gauge state right up to teardown.
@@ -1087,6 +1166,9 @@ class JaxBaseTrainer(BaseRLTrainer):
                             now = time.time()
                             since = now - getattr(self, "_telemetry_t0", forward_t0)
                             self._telemetry_t0 = now
+                            # The whole inter-flush stretch is train-lane
+                            # host time for the attribution ledger.
+                            obs_graftscope.host_interval("train", now - since, now)
                             stats_host.update(
                                 self._flush_device_telemetry(
                                     {"train": since, "wall": since}
@@ -1187,10 +1269,9 @@ class JaxBaseTrainer(BaseRLTrainer):
                         self.save()
                         return self.evaluate()
                 if timer is not None:
-                    timer.add(
-                        "train",
-                        max(0.0, time.time() - train_t0 - self._phase_exclude_s),
-                    )
+                    train_dt = max(0.0, time.time() - train_t0 - self._phase_exclude_s)
+                    timer.add("train", train_dt)
+                    obs_graftscope.host_interval("train", train_t0, train_t0 + train_dt)
             self._close_batch_feed()
             self.post_epoch_callback()
 
