@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost lint typecheck quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost lint typecheck quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network sanitize-drill
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -42,11 +42,28 @@ test-multihost:
 	$(TEST_ENV) python -m pytest -q -m slow \
 	    tests/test_multihost.py tests/test_distributed_resilience.py
 
-# graftlint: AST invariant checks (RUNBOOK §11). Blocking, < 30 s, stdlib
-# only — the analysis package must never import jax (pinned by
-# tests/test_analysis.py), so this runs on CPU-only CI images as-is.
+# graftlint + graftrace: AST invariant (GL001-GL007, RUNBOOK §11) and
+# concurrency (GL008-GL011, RUNBOOK §13) checks in one pass. Blocking,
+# < 30 s, stdlib only — the analysis package must never import jax (pinned
+# by tests/test_analysis.py), so this runs on CPU-only CI images as-is.
+# Second pass: the top-level scripts, under the rule families that apply
+# outside the package (no dispatch-lock/trace-purity surface there).
+SCRIPT_LINT_RULES = GL003,GL004,GL007,GL008,GL009,GL010,GL011
 lint:
 	python -m trlx_tpu.analysis trlx_tpu/
+	python -m trlx_tpu.analysis --select $(SCRIPT_LINT_RULES) \
+	    bench.py bench_smoke.py bench_decode_probe.py bench_reference.py \
+	    bench_trajectory.py obs_smoke.py acceptance_network.py
+
+# graftrace runtime half, fully armed: the thread-heavy suites (resilience
+# fault drills, overlap pipeline, rollout engine) under
+# TRLX_TPU_SANITIZE=dispatch,donation,race so lock-discipline, donation, and
+# lockset (Eraser) violations raise instead of deadlocking. Non-blocking CI
+# job; RUNBOOK §13 has the triage table for RaceViolation reports.
+sanitize-drill:
+	$(TEST_ENV) TRLX_TPU_SANITIZE=dispatch,donation,race python -m pytest -q \
+	    -m "not slow" tests/test_resilience.py tests/test_overlap.py \
+	    tests/test_engine.py tests/test_sanitize.py
 
 # Non-blocking type pass over the typed subset (analysis + engine). Degrades
 # to a notice when mypy isn't installed — nothing at runtime needs it, and

@@ -58,7 +58,9 @@ class _Scraper:
         self.worst_status = None
         self.scrapes = 0
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="trlx-obs-scraper", daemon=True
+        )
         self._thread.start()
 
     def _run(self):

@@ -392,11 +392,24 @@ def _run_ppo(task, ckpt_dir, **method_overrides):
     return model, records
 
 
-def test_ppo_with_rollout_engine_trains_and_tears_down(task, tmp_path):
-    model, records = _run_ppo(
-        task, tmp_path / "eng", rollout_engine=True, engine_slots=8,
-        prefill_batch=4, engine_steps_per_sync=4,
-    )
+def test_ppo_with_rollout_engine_trains_and_tears_down(task, tmp_path, monkeypatch):
+    # Fully-armed sanitizer: the engine e2e doubles as the dispatch-lock,
+    # donation, AND race (lockset) acceptance run — the engine migrates
+    # between the producer thread (per-phase) and the main thread (teardown),
+    # so every update_weights/shutdown handoff must keep the tracker clean.
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, "dispatch,donation,race")
+    try:
+        model, records = _run_ppo(
+            task, tmp_path / "eng", rollout_engine=True, engine_slots=8,
+            prefill_batch=4, engine_steps_per_sync=4,
+        )
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
+        sanitize.clear_races()
     losses = [r["loss"] for r in records if "loss" in r]
     assert len(losses) == 8 and all(np.isfinite(losses))
     # engine gauges flowed to the tracker

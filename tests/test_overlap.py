@@ -338,19 +338,22 @@ def test_overlap_at_staleness_zero_matches_serial_exactly(task, tmp_path):
 
 
 def test_max_staleness_one_trains_and_reports_staleness(task, tmp_path, monkeypatch):
-    # Armed sanitizer (utils/sanitize): the overlapped pipeline's producer /
-    # score-worker threads dispatch concurrently with the train loop, so this
-    # run doubles as the proof that every dispatch site holds the lock and no
-    # donated buffer is read back — violations raise instead of deadlocking.
+    # Fully-armed sanitizer (utils/sanitize): the overlapped pipeline's
+    # producer / score-worker threads dispatch concurrently with the train
+    # loop, so this run doubles as the proof that every dispatch site holds
+    # the lock, no donated buffer is read back, and every declared shared
+    # field keeps a non-empty lockset (the Eraser race tracker) — violations
+    # raise instead of deadlocking or corrupting silently.
     from trlx_tpu.utils import sanitize
 
-    monkeypatch.setenv(sanitize.ENV_VAR, "dispatch,donation")
+    monkeypatch.setenv(sanitize.ENV_VAR, "dispatch,donation,race")
     try:
         model, records = _run_ppo(task, tmp_path / "stale", max_staleness=1)
     finally:
         monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
         sanitize.refresh()
         sanitize.clear_donated()
+        sanitize.clear_races()
     assert model.iter_count >= 8
     stale = [r["staleness/mean"] for r in records if "staleness/mean" in r]
     # iteration 0's store is on-policy; every later batch is 1 stale

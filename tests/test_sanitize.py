@@ -25,6 +25,7 @@ def _sanitize_state(monkeypatch):
     monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
     sanitize.refresh()
     sanitize.clear_donated()
+    sanitize.clear_races()
 
 
 def _arm(monkeypatch, modes):
@@ -157,3 +158,117 @@ def test_donation_registry_is_capped(_sanitize_state):
     keep = [np.zeros((1,)) for _ in range(sanitize._DONATED_CAP + 10)]
     sanitize.mark_donated(keep, "bulk")
     assert len(sanitize._DONATED) <= sanitize._DONATED_CAP
+
+# ------------------------------------------------------------------- race
+
+
+class _Shared:
+    pass
+
+
+def _on_thread(fn, name="trlx-test-worker"):
+    err = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            err.append(e)
+
+    t = threading.Thread(target=run, name=name, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    if err:
+        raise err[0]
+
+
+def test_unarmed_race_factories_are_plain_and_access_is_noop():
+    lock = sanitize.make_lock("X.lock")
+    cond = sanitize.make_condition("X.cv")
+    assert type(lock) is type(threading.Lock())
+    assert type(cond) is threading.Condition
+    obj = _Shared()
+    sanitize.race_access(obj, "f", write=True)
+    _on_thread(lambda: sanitize.race_access(obj, "f", write=True))
+    assert len(sanitize._RACE_FIELDS) == 0  # zero residue
+
+
+def test_race_two_thread_conflict_names_both_sites(_sanitize_state):
+    _arm(_sanitize_state, "race")
+    obj = _Shared()
+    _on_thread(lambda: sanitize.race_access(obj, "count", write=True))
+    with pytest.raises(sanitize.RaceViolation) as exc:
+        sanitize.race_access(obj, "count", write=True)
+    msg = str(exc.value)
+    assert "'count'" in msg and "_Shared" in msg
+    assert "trlx-test-worker" in msg  # the other thread, by name
+    assert "MainThread" in msg
+    assert msg.count("test_sanitize.py") >= 2  # both stacks point here
+    # the raise resets the field to the current thread: no raise-storm
+    sanitize.race_access(obj, "count", write=True)
+
+
+def test_race_common_tracked_lock_is_clean(_sanitize_state):
+    _arm(_sanitize_state, "race")
+    obj = _Shared()
+    lock = sanitize.make_lock("Shared.lock")
+    assert isinstance(lock, sanitize.TrackedLock)
+
+    def locked_write():
+        with lock:
+            sanitize.race_access(obj, "count", write=True)
+
+    _on_thread(locked_write)
+    locked_write()  # same lock on the main thread: lockset stays non-empty
+
+
+def test_race_tracked_condition_counts_as_held(_sanitize_state):
+    _arm(_sanitize_state, "race")
+    obj = _Shared()
+    cv = sanitize.make_condition("Shared.cv")
+    assert isinstance(cv, sanitize.TrackedCondition)
+
+    def guarded():
+        with cv:
+            sanitize.race_access(obj, "ready", write=True)
+            cv.notify_all()
+
+    _on_thread(guarded)
+    guarded()
+
+
+def test_race_queue_handoff_with_forget_is_clean(_sanitize_state):
+    # The allowlisted-handoff pattern at runtime: worker builds the object,
+    # ships it through a Queue (a happens-before edge), and the consumer
+    # marks the ownership transfer with race_forget before touching it.
+    import queue
+
+    _arm(_sanitize_state, "race")
+    box = queue.Queue()
+
+    def producer():
+        obj = _Shared()
+        sanitize.race_access(obj, "payload", write=True)
+        obj.payload = 1
+        box.put(obj)
+
+    _on_thread(producer)
+    obj = box.get(timeout=5)
+    sanitize.race_forget(obj)
+    sanitize.race_access(obj, "payload", write=True)  # no raise: new owner
+
+
+def test_race_read_read_never_raises(_sanitize_state):
+    _arm(_sanitize_state, "race")
+    obj = _Shared()
+    _on_thread(lambda: sanitize.race_access(obj, "cfg"))
+    sanitize.race_access(obj, "cfg")  # concurrent reads are fine
+
+
+def test_race_registry_is_capped(_sanitize_state):
+    _arm(_sanitize_state, "race")
+    keep = [_Shared() for _ in range(sanitize._RACE_CAP + 16)]
+    for obj in keep:
+        sanitize.race_access(obj, "f", write=True)
+    assert len(sanitize._RACE_FIELDS) <= sanitize._RACE_CAP

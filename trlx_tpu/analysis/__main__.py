@@ -10,6 +10,7 @@ import argparse
 import sys
 
 from trlx_tpu.analysis.core import (
+    RULE_FAMILIES,
     RULE_TITLES,
     lint_paths,
     render_json,
@@ -20,7 +21,10 @@ from trlx_tpu.analysis.core import (
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m trlx_tpu.analysis",
-        description="graftlint: repo-specific AST invariant checks (GL001-GL007)",
+        description=(
+            "graftlint/graftrace: repo-specific AST invariant and "
+            "concurrency checks (GL001-GL011)"
+        ),
     )
     parser.add_argument(
         "paths",
@@ -40,8 +44,23 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule, title in sorted(RULE_TITLES.items()):
-            print(f"{rule}  {title}")
+        grouped = set()
+        for family, members in RULE_FAMILIES.items():
+            print(f"{family}:")
+            for rule in members:
+                if rule in RULE_TITLES:
+                    print(f"  {rule}  {RULE_TITLES[rule]}")
+                    grouped.add(rule)
+        orphans = sorted(set(RULE_TITLES) - grouped)
+        if orphans:
+            print("unfamilied:")
+            for rule in orphans:
+                print(f"  {rule}  {RULE_TITLES[rule]}")
+        print(
+            "\nsuppress with '# graftlint: disable=GLxxx -- <reason>' — the "
+            "reason is REQUIRED; a reasonless disable is itself a finding "
+            "(GL000) and waives nothing."
+        )
         return 0
 
     select = [r.strip() for r in args.select.split(",") if r.strip()] or None

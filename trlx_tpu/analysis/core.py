@@ -40,6 +40,17 @@ RULE_TITLES = {
     "GL005": "knob defaults: undeclared config knob read, or truthy feature default",
     "GL006": "tiling provenance: ad-hoc pl.BlockSpec in ops/ without tiling factories",
     "GL007": "metric-name conformance: key unsafe under sanitize_metric_name or colliding",
+    "GL008": "shared-write-without-lock: cross-thread attribute write with no common lock",
+    "GL009": "lock-order inversion: cycle in the static lock-acquisition graph",
+    "GL010": "unjoined/unregistered thread: leaks at exit or invisible to teardown checks",
+    "GL011": "blocking-call-under-dispatch-lock: sleep/IO/untimed wait starves dispatchers",
+}
+
+#: rule family → member ids, for the grouped `--list-rules` view. GL000 is
+#: the suppression meta-rule and belongs to the invariant family.
+RULE_FAMILIES = {
+    "invariant (graftlint, PR 11)": tuple(f"GL00{i}" for i in range(8)),
+    "concurrency (graftrace, PR 13)": ("GL008", "GL009", "GL010", "GL011"),
 }
 
 
@@ -175,7 +186,11 @@ def load_modules(paths: Sequence[str]) -> Tuple[List[Module], List[Finding]]:
 
 def lint_paths(paths: Sequence[str], select: Optional[Sequence[str]] = None):
     """Run every rule over ``paths``. Returns (findings, n_files)."""
+    from trlx_tpu.analysis import concurrency as conc_mod
     from trlx_tpu.analysis import rules as rules_mod
+
+    per_module_rules = rules_mod.PER_MODULE_RULES + conc_mod.PER_MODULE_RULES
+    global_rules = rules_mod.GLOBAL_RULES + conc_mod.GLOBAL_RULES
 
     modules, findings = load_modules(paths)
     wanted = set(select) if select else None
@@ -198,10 +213,10 @@ def lint_paths(paths: Sequence[str], select: Optional[Sequence[str]] = None):
                             "'# graftlint: disable=GLxxx -- <why>'",
                         )
                     )
-        for rule_id, check in rules_mod.PER_MODULE_RULES:
+        for rule_id, check in per_module_rules:
             if keep(rule_id):
                 findings.extend(check(module))
-    for rule_id, check in rules_mod.GLOBAL_RULES:
+    for rule_id, check in global_rules:
         if keep(rule_id):
             findings.extend(check(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

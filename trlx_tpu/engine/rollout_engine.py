@@ -193,6 +193,12 @@ class RolloutEngine:
         # trainer's pre-train_step state instead of the snapshot) fails HERE
         # with the donation site, not mid-decode with a deleted-array error.
         sanitize.check_host_read(variables, "engine.update_weights")
+        # The engine migrates threads at phase boundaries (producer thread in
+        # overlap mode, main thread serial / at teardown); each migration is
+        # ordered by the producer join or the phase handoff, and always passes
+        # through here first — reset the lockset history at the boundary.
+        sanitize.race_forget(self)
+        sanitize.race_access(self, "slot_state", write=True)
         self._variables = variables
         self.weight_version = version
 
@@ -218,6 +224,7 @@ class RolloutEngine:
                 "RolloutEngine.update_weights() must be called before step()"
             )
         self._ensure_state()
+        sanitize.race_access(self, "slot_state", write=True)
         self._admit()
         n_live = self.live_slots
         if n_live == 0:
@@ -391,6 +398,9 @@ class RolloutEngine:
         weight reference (learn()'s finally — mirrors the producer teardown).
         The engine owns no threads, so shutdown is synchronous and
         idempotent."""
+        # Teardown runs on main AFTER the producer join ordered every
+        # producer-side access before us — drop the stale lockset records.
+        sanitize.race_forget(self)
         self.abort()
         self._state = None
         self._variables = None

@@ -32,6 +32,8 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from trlx_tpu.utils import sanitize
+
 __all__ = ["sanitize_metric_name", "MetricsExporter"]
 
 # Prometheus metric names must match [a-zA-Z_:][a-zA-Z0-9_:]* — the tracker's
@@ -72,7 +74,7 @@ class MetricsExporter:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", prefix: str = "trlx_tpu_"):
         self.prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("MetricsExporter._lock")
         self._gauges = {}
         # (key, labels-tuple) -> {"buckets": (edges...), "counts": [..],
         # "sum": float, "count": int} — cumulative, Prometheus-style.
@@ -104,6 +106,10 @@ class MetricsExporter:
                 self.wfile.write(body)
 
         self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        # ThreadingHTTPServer daemonizes handler threads but still JOINS
+        # them in server_close() (block_on_close) — one wedged scrape
+        # connection would hang trainer teardown forever.
+        self._server.block_on_close = False
         self.port = int(self._server.server_address[1])
         self._thread = threading.Thread(
             target=self._server.serve_forever,
@@ -120,6 +126,7 @@ class MetricsExporter:
             k: float(v) for k, v in (gauges or {}).items() if isinstance(v, (int, float))
         }
         with self._lock:
+            sanitize.race_access(self, "_gauges", write=True)
             self._gauges.update(numeric)
             if step is not None:
                 self._step = int(step)
@@ -164,6 +171,7 @@ class MetricsExporter:
 
     def render_metrics(self) -> str:
         with self._lock:
+            sanitize.race_access(self, "_gauges")
             gauges = dict(self._gauges)
             histograms = {
                 k: {
@@ -225,4 +233,10 @@ class MetricsExporter:
     def close(self):
         self._server.shutdown()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # serve_forever never returned (wedged handler holding the
+            # poll loop) — closing the listener socket under it would
+            # race; leak the daemon thread and let exit reap it.
+            return
         self._server.server_close()
+        sanitize.race_forget(self)

@@ -50,7 +50,7 @@ import threading
 import time
 import warnings
 
-from trlx_tpu.utils import jsonl
+from trlx_tpu.utils import jsonl, sanitize
 
 __all__ = [
     "GraftScope",
@@ -160,7 +160,7 @@ class GraftScope:
         self.snapshot_path = snapshot_path
         self.top_k = int(top_k)
         self.max_windows = int(max_windows)
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("GraftScope._lock")
         self._device = []  # (t0, t1, name) completed fence intervals
         self._host = []  # (t0, t1, lane)
         self._refill_wait_ms = []
@@ -188,14 +188,17 @@ class GraftScope:
         leaf = _smallest_leaf(out)
         if leaf is None:
             return
-        if self._drain is None:
-            with self._lock:
-                if self._drain is None:
-                    t = threading.Thread(
-                        target=self._drain_loop, name=DRAIN_THREAD_NAME, daemon=True
-                    )
-                    self._drain = t
-                    t.start()
+        # Always under the lock: track_dispatch runs on every dispatching
+        # thread (main + producer), and close() swaps _drain out under the
+        # same lock — the old lock-free fast-path read could see a
+        # half-published thread object.
+        with self._lock:
+            if self._drain is None:
+                t = threading.Thread(
+                    target=self._drain_loop, name=DRAIN_THREAD_NAME, daemon=True
+                )
+                self._drain = t
+                t.start()
         self._pending.put((name, phase, time.time(), leaf))
 
     def _drain_loop(self):
@@ -210,15 +213,18 @@ class GraftScope:
                 # Donated/deleted buffer (the next step consumed it before
                 # the fence landed) — drop the sample, never the run.
                 with self._lock:
+                    sanitize.race_access(self, "_fences_dropped", write=True)
                     self._fences_dropped += 1
                 continue
             t_ready = time.time()
             with self._lock:
+                sanitize.race_access(self, "_device", write=True)
                 self._device.append((t_submit, t_ready, name))
 
     def host_interval(self, lane, t0, t1):
         if t1 > t0:
             with self._lock:
+                sanitize.race_access(self, "_host", write=True)
                 self._host.append((t0, t1, lane))
 
     # --------------------------------------------------------- engine slots
@@ -257,10 +263,13 @@ class GraftScope:
         with self._lock:
             t0w = self._win_t0
             self._win_t0 = t1w
+            sanitize.race_access(self, "_device", write=True)
             device, self._device = self._device, []
+            sanitize.race_access(self, "_host", write=True)
             host, self._host = self._host, []
             refill, self._refill_wait_ms = self._refill_wait_ms, []
             straggler, self._straggler = self._straggler, {}
+            sanitize.race_access(self, "_fences_dropped")
             fences_dropped = self._fences_dropped
         wall = max(t1w - t0w, 1e-9)
 
@@ -397,11 +406,14 @@ class GraftScope:
     def close(self):
         """Stop the drain thread (processing anything already queued) and
         write the final snapshot."""
-        drain = self._drain
+        with self._lock:
+            drain, self._drain = self._drain, None
         if drain is not None:
             self._pending.put(None)
             drain.join(timeout=30.0)
-            self._drain = None
+            if not drain.is_alive():
+                # Drain is gone: its accesses are fully ordered before ours.
+                sanitize.race_forget(self)
         self.flush()
 
 

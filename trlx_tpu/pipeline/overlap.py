@@ -34,6 +34,7 @@ from contextlib import contextmanager
 
 from trlx_tpu.observability import graftscope
 from trlx_tpu.observability.spans import trace_span
+from trlx_tpu.utils import sanitize
 
 
 class PhaseTimer:
@@ -99,12 +100,14 @@ class ScoreWorker:
 
     _STOP = object()
 
-    def __init__(self, fn, depth: int = 2, name: str = "trlx-score-worker"):
+    def __init__(self, fn, depth: int = 2):
         self._fn = fn
         self._in = queue.Queue(maxsize=max(1, int(depth)))
         self._out = queue.Queue()
         self.busy_s = 0.0  # wall inside fn; written only by the worker thread
-        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="trlx-score-worker", daemon=True
+        )
         self._thread.start()
 
     def _run(self):
@@ -120,6 +123,7 @@ class ScoreWorker:
                 self._out.put(("err", e))
             finally:
                 t1 = time.time()
+                sanitize.race_access(self, "busy_s", write=True)
                 self.busy_s += t1 - t0
                 graftscope.host_interval("score", t0, t1)
 
@@ -141,6 +145,10 @@ class ScoreWorker:
         worker exits."""
         self._in.put(self._STOP)
         self._thread.join()
+        # Joined: busy_s ownership transfers to the caller (the orchestrator
+        # reads it for the reward-phase accounting) — a real happens-before
+        # edge the lockset model cannot see.
+        sanitize.race_forget(self)
 
     @property
     def alive(self) -> bool:
@@ -268,7 +276,7 @@ class RolloutProducer:
         self._produce = produce
         self._new_store = new_store
         self.max_staleness = max(0, int(max_staleness))
-        self._cv = threading.Condition()
+        self._cv = sanitize.make_condition("RolloutProducer._cv")
         self._consumed = 0  # training iterations fully consumed
         self._ready = deque()  # completed stores, FIFO
         # Per-completed-store lineage (bounded): the store's index, the
@@ -280,27 +288,34 @@ class RolloutProducer:
         self.history = deque(maxlen=64)
         self._snapshot = None
         self._error = None
-        self._stop = False
+        self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="trlx-rollout-producer", daemon=True
         )
 
     def start(self, snapshot=None):
-        self._snapshot = snapshot
+        # Under the cv even though the thread starts just below: Thread.start
+        # is the happens-before edge for __init__ writes only — this write
+        # races with the worker's first snapshot read without it.
+        with self._cv:
+            sanitize.race_access(self, "_snapshot", write=True)
+            self._snapshot = snapshot
         self._thread.start()
         return self
 
     def _should_stop(self) -> bool:
-        return self._stop
+        return self._stop.is_set()
 
     def _run(self):
         index = 1
         while True:
             with self._cv:
-                while not self._stop and index - self._consumed > self.max_staleness:
+                while not self._stop.is_set() and index - self._consumed > self.max_staleness:
                     self._cv.wait(timeout=0.5)
-                if self._stop:
+                if self._stop.is_set():
                     return
+                sanitize.race_access(self, "_snapshot")
+                sanitize.race_access(self, "_consumed")
                 snapshot = self._snapshot
                 staleness = index - self._consumed
             store = self._new_store()
@@ -309,12 +324,14 @@ class RolloutProducer:
                     self._produce(store, index, snapshot, staleness, self._should_stop)
             except BaseException as e:  # noqa: BLE001 — re-raised in next_store()
                 with self._cv:
+                    sanitize.race_access(self, "_error", write=True)
                     self._error = e
                     self._cv.notify_all()
                 return
             with self._cv:
-                if self._stop:
+                if self._stop.is_set():
                     return  # aborted mid-phase: the partial store is dropped
+                sanitize.race_access(self, "_ready", write=True)
                 self._ready.append(store)
                 self.history.append(
                     {
@@ -334,8 +351,10 @@ class RolloutProducer:
         """Mark one training iteration fully consumed, optionally handing
         the producer the boundary snapshot to generate the next store from."""
         with self._cv:
+            sanitize.race_access(self, "_consumed", write=True)
             self._consumed += 1
             if snapshot is not None:
+                sanitize.race_access(self, "_snapshot", write=True)
                 self._snapshot = snapshot
             self._cv.notify_all()
 
@@ -345,8 +364,11 @@ class RolloutProducer:
         deadline = None if timeout is None else time.time() + timeout
         with self._cv:
             while True:
+                sanitize.race_access(self, "_ready")
                 if self._ready:
+                    sanitize.race_access(self, "_ready", write=True)
                     return self._ready.popleft()
+                sanitize.race_access(self, "_error")
                 if self._error is not None:
                     e, self._error = self._error, None
                     raise e
@@ -372,7 +394,10 @@ class RolloutProducer:
         stop poll; the thread is a daemon, so a truly wedged produce fn (e.g.
         hung user code past its own timeouts) cannot block process exit."""
         with self._cv:
-            self._stop = True
+            self._stop.set()
             self._cv.notify_all()
         if self._thread.ident is not None and self._thread.is_alive():
             self._thread.join(timeout=timeout)
+        if not self._thread.is_alive():
+            # Joined (or never started): remaining state is single-owner.
+            sanitize.race_forget(self)

@@ -43,6 +43,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from trlx_tpu.resilience.checkpoint import atomic_write_text
+from trlx_tpu.utils import sanitize
 
 # Distinct exit code for a deadline'd collective abort — supervisors (and the
 # 2-process drill) can tell "peer hang detected" from an ordinary crash.
@@ -82,6 +83,13 @@ class Heartbeat:
         self.process_index = (
             int(process_index) if process_index is not None else jax.process_index()
         )
+        # step/phase/progress_t are written by beat() on whichever thread
+        # makes progress and read by the writer thread's _write(): without a
+        # lock the JSON record can tear across the three fields (step from
+        # beat N, phase from beat N+1) — exactly what the stall diagnostic
+        # must not misread. GL008's finding; sanitize.make_lock also enrolls
+        # the accesses in race-mode lockset tracking.
+        self._beat_lock = sanitize.make_lock("Heartbeat._beat_lock")
         self.step = 0
         self.phase = "init"
         self.progress_t = time.time()
@@ -93,16 +101,18 @@ class Heartbeat:
         return os.path.join(self.directory, f"host_{self.process_index}.json")
 
     def beat(self, step: Optional[int] = None, phase: Optional[str] = None):
-        if step is not None:
-            self.step = int(step)
-        if phase is not None:
-            self.phase = phase
-        self.progress_t = time.time()
+        with self._beat_lock:
+            sanitize.race_access(self, "beat_state", write=True)
+            if step is not None:
+                self.step = int(step)
+            if phase is not None:
+                self.phase = phase
+            self.progress_t = time.time()
 
     def _write(self):
-        atomic_write_text(
-            self.path,
-            json.dumps(
+        with self._beat_lock:
+            sanitize.race_access(self, "beat_state")
+            payload = json.dumps(
                 {
                     "process": self.process_index,
                     "step": self.step,
@@ -110,8 +120,8 @@ class Heartbeat:
                     "progress_t": self.progress_t,
                     "written_t": time.time(),
                 }
-            ),
-        )
+            )
+        atomic_write_text(self.path, payload)
 
     def start(self):
         os.makedirs(self.directory, exist_ok=True)
