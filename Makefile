@@ -1,5 +1,5 @@
 # Dev targets (reference: Makefile style/quality; upgraded to ruff).
-.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost lint typecheck quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network sanitize-drill
+.PHONY: test test-fast test-shard1 test-shard2 test-shard3 test-multihost fleet-drill lint typecheck quality style bench bench-reference bench-smoke bench-trajectory obs-smoke acceptance-network sanitize-drill
 
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
@@ -40,7 +40,18 @@ test-shard3:
 # local drill command and the triage table.
 test-multihost:
 	$(TEST_ENV) python -m pytest -q -m slow \
-	    tests/test_multihost.py tests/test_distributed_resilience.py
+	    tests/test_multihost.py tests/test_distributed_resilience.py \
+	    tests/test_fleet_drill.py
+
+# 2-process graftfleet drills under the full runtime sanitizer set: the
+# slow_host drill (merged clock-aligned trace, skew table naming the
+# laggard, live fleet gauges) and the hang drill (cross-host incident
+# bundle). Set TRLX_TPU_DRILL_ARTIFACTS=<dir> to keep the merged trace +
+# report section (the CI job uploads them). Non-blocking CI job — same
+# jax.distributed caveats as test-multihost; RUNBOOK §14 has the triage.
+fleet-drill:
+	$(TEST_ENV) TRLX_TPU_SANITIZE=dispatch,donation,race python -m pytest -q \
+	    -m slow tests/test_fleet_drill.py
 
 # graftlint + graftrace: AST invariant (GL001-GL007, RUNBOOK §11) and
 # concurrency (GL008-GL011, RUNBOOK §13) checks in one pass. Blocking,

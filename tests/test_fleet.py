@@ -1,0 +1,457 @@
+"""graftfleet (trlx_tpu/observability/fleet.py): cross-host trace federation,
+collective straggler attribution, and fleet health rollup — unit tier.
+
+Covers the pure readers (read_fleet_spans merge semantics, the per-collective
+skew table), the FleetStragglerDetector hysteresis (persistent straggler vs
+one-off hiccup), the single-process FleetMonitor degradation (a one-host
+fleet: trivial clock, arrival recording, gauges, healthz block, incident
+bundles), the collective_guard arrival hook, and the MetricsExporter
+port-collision fallback. The 2-process CPU drills that exercise the REAL
+cross-host join live in tests/test_fleet_drill.py (slow tier).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.observability import fleet as obs_fleet
+from trlx_tpu.observability import spans as obs_spans
+from trlx_tpu.observability.export import MetricsExporter, sanitize_metric_name
+from trlx_tpu.resilience.distributed import collective_guard
+from trlx_tpu.utils import jsonl
+
+
+@pytest.fixture(autouse=True)
+def _fleet_isolation():
+    """The fleet monitor is a process global armed by trainers/tests — always
+    disarm so one test's files (in a deleted tmp_path) never leak forward."""
+    yield
+    obs_fleet.shutdown()
+    obs_spans.shutdown()
+
+
+def _write_host_spans(d, host, events):
+    path = os.path.join(d, obs_spans.host_spans_filename(host))
+    for e in events:
+        jsonl.append_record(path, e)
+    return path
+
+
+def _clock_record(d, offsets_s, uncertainty_s=0.001, drift_s=0.0005, step=0):
+    jsonl.append_record(
+        os.path.join(d, obs_spans.FLEET_CLOCK_FILENAME),
+        {
+            "offsets_s": offsets_s,
+            "uncertainty_s": uncertainty_s,
+            "drift_s": drift_s,
+            "step": step,
+        },
+    )
+
+
+# ----------------------------------------------------------- span federation
+
+
+def test_read_fleet_spans_merges_host_lanes_with_clock_alignment(tmp_path):
+    d = str(tmp_path)
+    # Overlapping synthetic tids on purpose: host 0 and host 1 both use
+    # tid 1/2 — the merge must keep the lanes distinct.
+    _write_host_spans(
+        d,
+        0,
+        [
+            {"name": "train/step", "ph": "X", "ts": 1_000_000, "dur": 10, "pid": 9, "tid": 1},
+            {"name": "producer", "ph": "X", "ts": 1_000_050, "dur": 5, "pid": 9, "tid": 2},
+        ],
+    )
+    _write_host_spans(
+        d,
+        1,
+        [
+            {"name": "train/step", "ph": "X", "ts": 2_000_000, "dur": 10, "pid": 9, "tid": 1},
+        ],
+    )
+    # Host 1's wall clock runs 1s ahead of host 0's.
+    _clock_record(d, [0.0, 1.0], uncertainty_s=0.002, drift_s=0.001)
+
+    merged = obs_spans.read_fleet_spans(d)
+    assert merged["hosts"] == [0, 1]
+    # Stated alignment bound = estimate uncertainty + drift bound.
+    assert merged["alignment_error_s"] == pytest.approx(0.003)
+
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    by_host = {h: [e for e in spans if e["pid"] == h] for h in (0, 1)}
+    # pids forced to the host index; tids remapped host*TID_STRIDE + tid.
+    assert {e["tid"] for e in by_host[0]} == {1, 2}
+    assert {e["tid"] for e in by_host[1]} == {obs_spans.TID_STRIDE + 1}
+    # Host 1's timestamps shifted into host 0's frame by −offset (1s → µs).
+    assert by_host[1][0]["ts"] == 2_000_000 - 1_000_000
+    assert by_host[0][0]["ts"] == 1_000_000  # host 0 is the reference frame
+
+    # One process_name metadata lane per host, stating offset ± bound.
+    lanes = {
+        e["pid"]: e["args"]["name"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "host0" in lanes[0]
+    assert "+1000.000ms" in lanes[1] and "3.000ms" in lanes[1]
+
+
+def test_read_fleet_spans_tolerates_torn_tail_per_file(tmp_path):
+    d = str(tmp_path)
+    _write_host_spans(d, 0, [{"name": "a", "ph": "X", "ts": 1, "dur": 1, "tid": 1}])
+    path1 = _write_host_spans(
+        d, 1, [{"name": "b", "ph": "X", "ts": 2, "dur": 1, "tid": 1}]
+    )
+    with open(path1, "a") as f:
+        f.write('{"name": "torn')  # killed writer: partial final line
+    merged = obs_spans.read_fleet_spans(d)
+    names = {e["name"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert names == {"a", "b"}  # torn tail dropped, both hosts still merge
+    assert merged["hosts"] == [0, 1]
+
+
+def test_read_fleet_spans_falls_back_to_plain_spans_jsonl(tmp_path):
+    d = str(tmp_path)
+    jsonl.append_record(
+        os.path.join(d, obs_spans.SPANS_FILENAME),
+        {"name": "solo", "ph": "X", "ts": 5, "dur": 1, "pid": 0, "tid": 7},
+    )
+    merged = obs_spans.read_fleet_spans(d)
+    [event] = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    # Legacy single file: events pass through untouched (no remap, no shift).
+    assert event["tid"] == 7 and event["ts"] == 5
+    assert merged["clock"] is None and merged["alignment_error_s"] == 0.0
+    # And an empty dir yields an empty merge, not a crash.
+    assert obs_spans.read_fleet_spans(str(tmp_path / "nope"))["traceEvents"] == []
+
+
+# ----------------------------------------------------- straggler attribution
+
+
+def _write_arrivals(d, host, records):
+    path = os.path.join(d, obs_fleet.host_collectives_filename(host))
+    for r in records:
+        jsonl.append_record(path, r)
+
+
+def test_collective_skew_table_names_the_laggard(tmp_path):
+    d = str(tmp_path)
+    base = 1000.0
+    rec0, rec1 = [], []
+    for seq in range(10):
+        t = base + seq
+        rec0.append({"site": "allgather_host", "seq": seq, "host": 0, "t0": t, "t1": t + 0.01})
+        # Host 1 arrives 50ms late at this site, every occurrence.
+        rec1.append({"site": "allgather_host", "seq": seq, "host": 1, "t0": t + 0.05, "t1": t + 0.06})
+        # A balanced site: both hosts arrive within the noise floor.
+        rec0.append({"site": "barrier", "seq": seq, "host": 0, "t0": t, "t1": t + 0.001})
+        rec1.append({"site": "barrier", "seq": seq, "host": 1, "t0": t + 0.002, "t1": t + 0.003})
+    _write_arrivals(d, 0, rec0)
+    _write_arrivals(d, 1, rec1)
+
+    rows = {r["site"]: r for r in obs_fleet.collective_skew_table(d, offsets=[0.0, 0.0])}
+    lag = rows["allgather_host"]
+    assert lag["count"] == 10
+    assert lag["worst_host"] == 1 and lag["worst_share"] == pytest.approx(1.0)
+    assert lag["p50_ms"] == pytest.approx(50.0, abs=1.0)
+    assert lag["max_ms"] == pytest.approx(50.0, abs=1.0)
+    # Sub-floor skew is measured but attributed to nobody.
+    assert rows["barrier"]["worst_host"] is None
+    assert rows["barrier"]["p50_ms"] == pytest.approx(2.0, abs=0.5)
+
+
+def test_collective_skew_table_applies_clock_offsets(tmp_path):
+    d = str(tmp_path)
+    # Host 1's RAW stamps are 1s ahead (clock offset), but it arrives in
+    # sync — without alignment it would look like a 1s straggler.
+    _write_arrivals(d, 0, [{"site": "s", "seq": 0, "host": 0, "t0": 10.0, "t1": 10.1}])
+    _write_arrivals(d, 1, [{"site": "s", "seq": 0, "host": 1, "t0": 11.0, "t1": 11.1}])
+    _clock_record(d, [0.0, 1.0])
+    [row] = obs_fleet.collective_skew_table(d)  # offsets default from the clock file
+    assert row["max_ms"] == pytest.approx(0.0, abs=1e-6)
+    assert row["worst_host"] is None
+
+
+def test_read_collective_arrivals_tolerates_torn_and_garbage(tmp_path):
+    d = str(tmp_path)
+    _write_arrivals(d, 0, [{"site": "s", "seq": 0, "host": 0, "t0": 1.0, "t1": 2.0}])
+    path = os.path.join(d, obs_fleet.host_collectives_filename(1))
+    jsonl.append_record(path, {"site": "s", "seq": "not-an-int", "t0": 1, "t1": 2})
+    with open(path, "a") as f:
+        f.write('{"site": "s", "se')
+    arrivals = obs_fleet.read_collective_arrivals(d)
+    assert arrivals == {("s", 0): {0: (1.0, 2.0)}}
+
+
+def test_fleet_straggler_detector_persistence_and_reset():
+    det = obs_fleet.FleetStragglerDetector(warn_streak=2, crit_streak=4)
+    obs = lambda host, share: {"host": host, "share": share, "samples": 5}  # noqa: E731
+
+    # A one-off hiccup that migrates between hosts never escalates: the
+    # candidate change resets the persistence clock each window.
+    for host in (0, 1, 0, 1, 0, 1):
+        assert det.observe(obs(host, 1.0)) == "ok"
+
+    # The same host staying worst escalates WARN → CRIT on the streaks.
+    det = obs_fleet.FleetStragglerDetector(warn_streak=2, crit_streak=4)
+    assert det.observe(obs(1, 0.95)) == "ok"  # candidate set, clock starts
+    states = [det.observe(obs(1, 0.95)) for _ in range(5)]
+    assert states[1] == "warn" and states[-1] == "crit"
+    assert det.host == 1 and det.share == pytest.approx(0.95)
+
+    # Idle/thin windows (few above-floor samples) don't judge.
+    det = obs_fleet.FleetStragglerDetector(min_samples=3)
+    assert det.observe({"host": 1, "share": 1.0, "samples": 2}) == "ok"
+    assert det.observe({"host": None, "share": 0.0, "samples": 0}) == "ok"
+
+
+# ------------------------------------------------------------- FleetMonitor
+
+
+def test_single_process_monitor_degrades_to_one_host_fleet(tmp_path):
+    d = str(tmp_path)
+    monitor = obs_fleet.configure(d, process_index=0, process_count=1)
+    assert obs_fleet.armed() and obs_fleet.fleet() is monitor
+
+    # Clock sync without peers: trivial offsets, record still lands.
+    rec = monitor.clock_sync(step=0)
+    assert rec["offsets_s"] == [0.0] and rec["hosts"] == 1
+    clock = obs_spans._last_clock_record(d)
+    assert clock is not None and clock["offsets_s"] == [0.0]
+
+    # The module hook (collective_guard's path) records (site, seq) arrivals.
+    t = time.time()
+    obs_fleet.collective_complete("allgather_host", t, t + 0.01)
+    obs_fleet.collective_complete("allgather_host", t + 1, t + 1.01)
+    arrivals = obs_fleet.read_collective_arrivals(d)
+    assert set(arrivals) == {("allgather_host", 0), ("allgather_host", 1)}
+    assert arrivals[("allgather_host", 0)][0] == (pytest.approx(t), pytest.approx(t + 0.01))
+
+    gauges = monitor.on_log_boundary(step=3)
+    assert gauges["fleet/hosts"] == 1.0
+    assert gauges["fleet/collective_skew_ms_max"] == pytest.approx(0.0)
+    assert gauges["fleet/straggler_state"] == 0.0
+
+    block = monitor.health_block()
+    assert block["hosts"] == 1 and block["desync"] == {"status": "unchecked"}
+    assert block["straggler"]["state"] == "ok"
+    monitor.note_desync(3, ok=True)
+    assert monitor.health_block()["desync"] == {"step": 3, "ok": True}
+
+
+def test_disarmed_hooks_are_noops_and_write_no_files(tmp_path):
+    obs_fleet.shutdown()
+    assert not obs_fleet.armed()
+    obs_fleet.collective_complete("x", 1.0, 2.0)
+    assert obs_fleet.incident_bundle(0, "collective_timeout") is None
+    assert os.listdir(str(tmp_path)) == []  # nothing appeared anywhere near us
+
+
+def test_collective_guard_records_arrival_when_armed(tmp_path):
+    obs_fleet.configure(str(tmp_path), process_index=0, process_count=1)
+    with collective_guard("drill_site", deadline=30.0, on_timeout=lambda e: None):
+        pass
+    # deadline 0 guards still stamp arrivals (attribution without the timer).
+    with collective_guard("drill_site", deadline=0.0, on_timeout=lambda e: None):
+        pass
+    arrivals = obs_fleet.read_collective_arrivals(str(tmp_path))
+    assert set(arrivals) == {("drill_site", 0), ("drill_site", 1)}
+
+
+def test_window_rollup_watermark_defers_incomplete_occurrences(tmp_path):
+    d = str(tmp_path)
+    monitor = obs_fleet.configure(d, process_index=0, process_count=2)
+    base = 100.0
+    _write_arrivals(d, 0, [
+        {"site": "s", "seq": 0, "host": 0, "t0": base, "t1": base + 0.01},
+        {"site": "s", "seq": 1, "host": 0, "t0": base + 1, "t1": base + 1.01},
+    ])
+    # Host 1 has only seq 0 so far (lagging writer).
+    _write_arrivals(d, 1, [
+        {"site": "s", "seq": 0, "host": 1, "t0": base + 0.05, "t1": base + 0.06},
+    ])
+    gauges = monitor.on_log_boundary(step=1)
+    assert gauges["fleet/collective_skew_ms_max"] == pytest.approx(50.0, abs=1.0)
+    assert gauges["fleet/slowest_host"] == 1.0
+    assert gauges["fleet/host1_worst_arrivals_total"] == 1.0
+
+    # Host 1's seq 1 lands later: the next window picks it up (not dropped),
+    # and the already-judged seq 0 is not double-counted.
+    _write_arrivals(d, 1, [
+        {"site": "s", "seq": 1, "host": 1, "t0": base + 1.05, "t1": base + 1.06},
+    ])
+    gauges = monitor.on_log_boundary(step=2)
+    assert gauges["fleet/host1_worst_arrivals_total"] == 2.0
+
+
+def test_incident_bundle_collects_all_hosts_span_tails(tmp_path):
+    d = str(tmp_path)
+    monitor = obs_fleet.configure(d, process_index=0, process_count=2)
+    _write_host_spans(d, 0, [{"name": "a", "ph": "X", "ts": 1, "dur": 1, "tid": 1}])
+    _write_host_spans(d, 1, [{"name": "b", "ph": "X", "ts": 2, "dur": 1, "tid": 1}])
+    monitor.note_fingerprint(7, np.asarray([7, 123, 456]))
+
+    base = monitor.incident_bundle(7, "collective_timeout", detail={"collective": "s"})
+    assert base == os.path.join(d, "incidents", "7")
+    # BOTH hosts' span tails — the aborting host collects its wedged peer's
+    # file from the shared dir.
+    for host, name in ((0, "a"), (1, "b")):
+        tail = os.path.join(base, f"host{host}", "spans_tail.jsonl")
+        records = jsonl.read_jsonl(tail)
+        assert records and records[0]["name"] == name
+    with open(os.path.join(base, "host0", "heartbeat.json")) as f:
+        hb0 = json.load(f)
+    assert hb0["last_fingerprint"]["step"] == 7  # the aborting host's own
+    with open(os.path.join(base, "fleet_incident.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "collective_timeout"
+    assert manifest["hosts"] == [0, 1] and manifest["collected_by"] == 0
+
+    # Budget: a flapping guard cannot fill the disk.
+    for step in range(8, 8 + 2 * obs_fleet.MAX_FLEET_BUNDLES):
+        monitor.incident_bundle(step, "collective_timeout")
+    bundles = [n for n in os.listdir(os.path.join(d, "incidents"))]
+    assert len(bundles) == obs_fleet.MAX_FLEET_BUNDLES
+
+
+def test_tail_whole_lines_trims_partial_first_line(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    lines = [json.dumps({"i": i, "pad": "x" * 100}) for i in range(50)]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    tail = obs_fleet._tail_whole_lines(path, max_bytes=500)
+    parsed = [json.loads(ln) for ln in tail.decode().splitlines()]
+    assert parsed  # something survived
+    assert parsed[-1]["i"] == 49  # ends at the true tail
+    assert all(p["i"] > 40 for p in parsed)  # only the tail window
+
+
+# ---------------------------------------------------------- exporter pieces
+
+
+def test_metrics_exporter_port_collision_rebinds_ephemeral(tmp_path):
+    first = MetricsExporter(0)  # ephemeral
+    port_file = str(tmp_path / "metrics_port")
+    second = MetricsExporter(first.port, port_file=port_file)  # busy port
+    try:
+        assert second.requested_port == first.port
+        assert second.port != first.port and second.port > 0
+        # The actual port is discoverable: gauge + breadcrumb file.
+        assert f"{second.port}" in open(port_file).read()
+        body = second.render_metrics()
+        name = sanitize_metric_name("trlx_tpu_obs/metrics_port")
+        assert f"{name} {float(second.port)!r}" in body
+    finally:
+        first.close()
+        second.close()
+
+
+def test_exporter_healthz_fleet_block(tmp_path):
+    exporter = MetricsExporter(0)
+    try:
+        payload = exporter.render_healthz()
+        assert "fleet" not in payload  # absent until a fleet arms
+        monitor = obs_fleet.configure(str(tmp_path), process_index=0, process_count=1)
+        monitor.on_log_boundary(step=5, exporter=exporter)
+        payload = exporter.render_healthz()
+        assert payload["fleet"]["hosts"] == 1
+        assert payload["fleet"]["straggler"]["state"] == "ok"
+        assert "clock" in payload["fleet"]
+        # And the gauges rode along into the exposition.
+        body = exporter.render_metrics()
+        assert sanitize_metric_name("trlx_tpu_fleet/hosts") + " 1.0" in body
+    finally:
+        exporter.close()
+
+
+# ----------------------------------------------------------- report section
+
+
+def test_report_fleet_section_renders_artifacts(tmp_path):
+    from trlx_tpu.observability.report import _fleet_section
+
+    d = str(tmp_path)
+    # Nothing armed → the actionable fallback, not a crash.
+    lines = _fleet_section(d)
+    assert any("train.graftfleet off" in ln for ln in lines)
+
+    _write_host_spans(d, 0, [{"name": "a", "ph": "X", "ts": 1, "dur": 1, "tid": 1}])
+    _write_host_spans(d, 1, [{"name": "b", "ph": "X", "ts": 2, "dur": 1, "tid": 1}])
+    _clock_record(d, [0.0, 0.25])
+    base = 50.0
+    _write_arrivals(d, 0, [{"site": "s", "seq": 0, "host": 0, "t0": base, "t1": base + 0.1}])
+    _write_arrivals(d, 1, [{"site": "s", "seq": 0, "host": 1, "t0": base + 0.3, "t1": base + 0.4}])
+    text = "\n".join(_fleet_section(d))
+    assert "clock-alignment error" in text
+    assert "| s |" in text and "host 1" in text  # skew table names the laggard
+    assert "host1 +250.000ms" in text
+
+
+# ------------------------------------------------------------ e2e (1 host)
+
+
+def test_e2e_single_process_armed_run_degrades_to_one_host_fleet(tmp_path, monkeypatch):
+    """graftfleet armed on ONE process: the fleet degrades to a one-host
+    fleet — host-suffixed span file, clock history (trivial offsets, startup
+    + every fleet_resync_interval steps), fleet/* gauges in metrics.jsonl,
+    and a renderable Fleet report section. Armed via the env override (the
+    config knob path is the 2-process drill's job)."""
+    from trlx_tpu.observability.report import build_report
+
+    monkeypatch.setenv("TRLX_TPU_GRAFTFLEET", "1")
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 6
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.fleet_resync_interval = 2
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert not obs_fleet.armed()  # learn() tears the global monitor down
+
+    d = str(tmp_path)
+    # Fleet owns the span filename: host-suffixed, no plain spans.jsonl.
+    assert os.path.exists(os.path.join(d, obs_spans.host_spans_filename(0)))
+    assert not os.path.exists(os.path.join(d, obs_spans.SPANS_FILENAME))
+    # Clock history: startup sync + resyncs at steps 2/4/6, trivial offsets.
+    clock_records = jsonl.read_jsonl(os.path.join(d, obs_spans.FLEET_CLOCK_FILENAME))
+    assert len(clock_records) >= 3
+    assert all(r["offsets_s"] == [0.0] and r["hosts"] == 1 for r in clock_records)
+    assert {r["step"] for r in clock_records} >= {0, 2, 4}
+
+    merged = obs_spans.read_fleet_spans(d)
+    assert merged["hosts"] == [0]
+    assert any(e.get("ph") == "X" for e in merged["traceEvents"])
+
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    fleet_rows = [r for r in records if "fleet/hosts" in r]
+    assert fleet_rows and all(r["fleet/hosts"] == 1.0 for r in fleet_rows)
+    assert all("fleet/straggler_state" in r for r in fleet_rows)
+
+    md = build_report(d)
+    assert "## Fleet (graftfleet)" in md
+    assert "clock-alignment error" in md

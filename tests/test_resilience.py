@@ -477,6 +477,12 @@ def test_heartbeat_write_read_and_stall_report(tmp_path):
     beats = read_heartbeats(d)
     assert set(beats) == {0, 1}
     assert beats[0]["step"] == 7 and beats[1]["phase"] == "train"
+    # Dual clock bases in every payload: wall (progress_t/written_t) for
+    # cross-host comparison, monotonic twins for NTP-slew-proof ages —
+    # graftfleet's health block and skew estimation read both.
+    for rec in beats.values():
+        assert {"progress_t", "progress_mono", "written_t", "written_mono"} <= set(rec)
+        assert rec["written_mono"] >= rec["progress_mono"] > 0.0
 
     # host 0 is INSIDE the collective (a waiter); host 1 never arrived and
     # has the oldest progress → the report names host 1

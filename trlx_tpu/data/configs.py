@@ -234,6 +234,21 @@ class TrainConfig:
     # gap histograms, and the engine slot timeline. Implies span tracing +
     # device telemetry while armed. TRLX_TPU_GRAFTSCOPE=1 overrides.
     graftscope: bool = False
+    # graftfleet (trlx_tpu/observability/fleet.py): cross-host trace
+    # federation (per-host spans.host<k>.jsonl + a barrier-based clock-offset
+    # estimator so read_fleet_spans merges one aligned Chrome trace),
+    # collective straggler attribution (per-site arrival records ->
+    # fleet/collective_skew_ms_* gauges + the FleetStragglerDetector), the
+    # /healthz fleet block, and the HostDesync/CollectiveTimeout fleet
+    # incident bundles. Implies span tracing while armed; single-process
+    # arming degrades to a one-host fleet. Must be config-consistent across
+    # hosts (the per-host metric rollup is collective).
+    # TRLX_TPU_GRAFTFLEET=1 overrides.
+    graftfleet: bool = False
+    # Re-estimate the cross-host clock offsets every N train steps (two tiny
+    # guarded allgathers per resync; the drift bound between resyncs is part
+    # of the trace's stated alignment error). 0 = startup-only estimate.
+    fleet_resync_interval: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -1,7 +1,8 @@
 """Unified observability layer (PR 8) + training-health monitor (PR 9)
-+ graftscope attribution ledger & run forensics (PR 12).
++ graftscope attribution ledger & run forensics (PR 12)
++ graftfleet cross-host federation (PR 14).
 
-Seven parts, all off-hot-path and off by default:
+Eight parts, all off-hot-path and off by default:
 
 - ``spans``     — cross-thread Chrome-trace span tracing into
                   ``<ckpt_dir>/spans.jsonl`` (``train.trace_spans`` /
@@ -28,14 +29,22 @@ Seven parts, all off-hot-path and off by default:
                   pipeline-bubble accounting with per-lane gap histograms,
                   engine slot timeline, and the crash-proof ``RunManifest``
                   bench forensics (``train.graftscope`` /
-                  ``TRLX_TPU_GRAFTSCOPE=1``).
+                  ``TRLX_TPU_GRAFTSCOPE=1``);
+- ``fleet``     — graftfleet cross-host federation: per-host span lanes
+                  merged under a barrier-estimated clock alignment,
+                  per-collective straggler attribution from guarded-
+                  collective arrival records, fleet health rollup on
+                  ``/healthz``, and cross-host incident bundles
+                  (``train.graftfleet`` / ``TRLX_TPU_GRAFTFLEET=1``).
 
-See RUNBOOK.md §8 (performance), §9 (training health) and §12 (device-time
-attribution & run forensics) for knobs and triage.
+See RUNBOOK.md §8 (performance), §9 (training health), §12 (device-time
+attribution & run forensics) and §14 (fleet observability) for knobs and
+triage.
 """
 
 import os
 
+from trlx_tpu.observability import fleet  # noqa: F401 — canonical import point
 from trlx_tpu.observability import graftscope  # noqa: F401 — canonical import point
 from trlx_tpu.observability import spans  # noqa: F401 — canonical import point
 from trlx_tpu.observability.anomaly import AnomalyDetector, IncidentCapture  # noqa: F401

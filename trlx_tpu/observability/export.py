@@ -72,7 +72,8 @@ class MetricsExporter:
     it merges, so gauges logged at different cadences (per-step stats,
     per-window phase stats) coexist in one scrape."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0", prefix: str = "trlx_tpu_"):
+    def __init__(self, port: int = 0, host: str = "0.0.0.0", prefix: str = "trlx_tpu_",
+                 port_file=None):
         self.prefix = prefix
         self._lock = sanitize.make_lock("MetricsExporter._lock")
         self._gauges = {}
@@ -80,6 +81,7 @@ class MetricsExporter:
         # "sum": float, "count": int} — cumulative, Prometheus-style.
         self._histograms = {}
         self._health = None
+        self._fleet = None  # graftfleet's /healthz block (set_fleet)
         self._step = 0
         exporter = self
 
@@ -105,12 +107,40 @@ class MetricsExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self.requested_port = int(port)
+        try:
+            self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        except OSError:
+            # Port busy (two hosts on one box, a stale run's exporter): bind
+            # an ephemeral port instead of crashing the trainer. The actual
+            # port is logged, exposed as the obs/metrics_port gauge, and
+            # written to port_file — a scraper can always find it.
+            self._server = ThreadingHTTPServer((host, 0), Handler)
         # ThreadingHTTPServer daemonizes handler threads but still JOINS
         # them in server_close() (block_on_close) — one wedged scrape
         # connection would hang trainer teardown forever.
         self._server.block_on_close = False
         self.port = int(self._server.server_address[1])
+        if self.requested_port and self.port != self.requested_port:
+            import sys
+
+            print(
+                f"[trlx_tpu.observability] metrics port {self.requested_port} "
+                f"busy — serving /metrics on port {self.port} instead "
+                "(see the obs/metrics_port gauge / metrics_port file)",
+                file=sys.stderr,
+                flush=True,
+            )
+        with self._lock:
+            sanitize.race_access(self, "_gauges", write=True)
+            self._gauges["obs/metrics_port"] = float(self.port)
+        self.port_file = port_file
+        if port_file:
+            try:
+                with open(port_file, "w") as f:
+                    f.write(f"{self.port}\n")
+            except OSError:
+                pass  # advisory breadcrumb only
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name="trlx-metrics-exporter",
@@ -132,6 +162,12 @@ class MetricsExporter:
                 self._step = int(step)
             if health is not None:
                 self._health = health
+
+    def set_fleet(self, payload):
+        """Attach graftfleet's fleet block (per-host heartbeat ages, desync
+        status, straggler verdict, clock estimate) to /healthz."""
+        with self._lock:
+            self._fleet = payload
 
     def observe(self, key: str, values, buckets, labels: dict = None):
         """Fold ``values`` into the cumulative histogram ``key`` (creating
@@ -223,10 +259,13 @@ class MetricsExporter:
     def render_healthz(self) -> dict:
         with self._lock:
             health = self._health
+            fleet = self._fleet
             step = self._step
         payload = {"status": "unknown", "detectors": {}}
         if health:
             payload.update(health)
+        if fleet is not None:
+            payload["fleet"] = fleet
         payload["step"] = step
         return payload
 

@@ -416,6 +416,17 @@ class HealthMonitor:
         self.entropy_scale = 1.0
         self._drift_from_call = None
 
+    def register_detector(self, detector: HysteresisDetector):
+        """Adopt an externally-owned detector (graftfleet's
+        FleetStragglerDetector): its state rides the health/* gauges and
+        /healthz, and a CRIT transition escalates through the same incident
+        hook as the built-ins. The OWNER keeps feeding observe() — the
+        monitor only reads state."""
+        with self._lock:
+            self.detectors[detector.name] = detector
+            detector.on_crit = self._escalate
+        return detector
+
     # ------------------------------------------------------------ drills
 
     def inject_reward_drift(self, from_call=None):

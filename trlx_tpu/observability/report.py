@@ -32,13 +32,19 @@ __all__ = ["build_report", "rollup_window_stats", "main"]
 # ------------------------------------------------------------------ rollup
 
 
-def rollup_window_stats(stats: dict) -> dict:
+def rollup_window_stats(stats: dict, per_host: bool = False) -> dict:
     """Aggregate one window's scalar stats across hosts.
 
     Returns ``{key/hostmean, key/hostmax}`` for every float-valued key, via
     ``allgather_host`` — so it MUST be called collectively (every host, same
     window boundary). Identity-shaped at process_count()==1: the mean/max of
-    one host is itself (tests exercise this path; pods get the real gather)."""
+    one host is itself (tests exercise this path; pods get the real gather).
+
+    ``per_host=True`` (graftfleet armed — must be config-consistent, the
+    flag changes nothing about the gather itself) additionally emits every
+    host's own value as ``fleet/host{k}/<key>`` plus ``key/hostmin`` /
+    ``key/hostspread`` fleet-level views, all from the SAME gathered matrix
+    — no extra collective."""
     import jax
 
     keys = sorted(k for k, v in stats.items() if isinstance(v, (int, float)))
@@ -55,6 +61,11 @@ def rollup_window_stats(stats: dict) -> dict:
     for j, key in enumerate(keys):
         out[f"{key}/hostmean"] = float(gathered[:, j].mean())
         out[f"{key}/hostmax"] = float(gathered[:, j].max())
+        if per_host:
+            out[f"{key}/hostmin"] = float(gathered[:, j].min())
+            out[f"{key}/hostspread"] = float(gathered[:, j].max() - gathered[:, j].min())
+            for host in range(gathered.shape[0]):
+                out[f"fleet/host{host}/{key}"] = float(gathered[host, j])
     return out
 
 
@@ -254,13 +265,91 @@ def _graftscope_section(checkpoint_dir):
     return lines
 
 
+# ----------------------------------------------------------------- fleet
+
+
+def _fleet_section(checkpoint_dir):
+    """Render graftfleet's federation artifacts: the merged multi-host
+    timeline summary (with the stated clock-alignment bound), the
+    per-collective skew table naming the worst-arrival host per site, and
+    the per-host heartbeat summary."""
+    from trlx_tpu.observability import fleet as obs_fleet
+    from trlx_tpu.observability.spans import read_fleet_spans
+    from trlx_tpu.resilience.distributed import read_heartbeats
+
+    lines = ["## Fleet (graftfleet)", ""]
+    merged = read_fleet_spans(checkpoint_dir)
+    arrivals = obs_fleet.read_collective_arrivals(checkpoint_dir)
+    if merged["clock"] is None and not arrivals:
+        lines.append("No fleet artifacts (train.graftfleet off — set it or TRLX_TPU_GRAFTFLEET=1).")
+        lines.append("")
+        return lines
+    clock = merged["clock"] or {}
+    offsets = clock.get("offsets_s", [])
+    lines.append(
+        f"- merged trace: {len(merged['traceEvents'])} events across host lane(s) "
+        f"{merged['hosts']} · clock-alignment error ≤ {merged['alignment_error_s'] * 1e3:.3f}ms "
+        f"(estimate uncertainty + drift, fleet_clock.jsonl step {clock.get('step', '?')})"
+    )
+    if offsets:
+        lines.append(
+            "- clock offsets vs host 0: "
+            + " · ".join(f"host{k} {v * 1e3:+.3f}ms" for k, v in enumerate(offsets))
+        )
+    lines.append("")
+    rows = obs_fleet.collective_skew_table(checkpoint_dir)
+    if rows:
+        lines.append("### Per-collective skew")
+        lines.append("")
+        lines.append("| site | occurrences | p50_ms | p95_ms | max_ms | worst host | worst share |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for row in rows:
+            worst = "-" if row["worst_host"] is None else f"host {row['worst_host']}"
+            lines.append(
+                f"| {row['site']} | {row['count']} | {_fmt(row['p50_ms'], 1)} "
+                f"| {_fmt(row['p95_ms'], 1)} | {_fmt(row['max_ms'], 1)} "
+                f"| {worst} | {_fmt(row['worst_share'], 2)} |"
+            )
+        lines.append("")
+    beats = read_heartbeats(os.path.join(checkpoint_dir, "heartbeats"))
+    if beats:
+        lines.append("### Per-host heartbeat summary")
+        lines.append("")
+        lines.append("| host | last step | phase | progress_t | written_t |")
+        lines.append("|---|---|---|---|---|")
+        for host, rec in sorted(beats.items()):
+            lines.append(
+                f"| {host} | {rec.get('step')} | {rec.get('phase')} "
+                f"| {_fmt(rec.get('progress_t'), 1)} | {_fmt(rec.get('written_t'), 1)} |"
+            )
+        lines.append("")
+    incident = os.path.join(checkpoint_dir, "incidents")
+    fleet_bundles = []
+    if os.path.isdir(incident):
+        for name in sorted(os.listdir(incident)):
+            if os.path.exists(os.path.join(incident, name, "fleet_incident.json")):
+                fleet_bundles.append(name)
+    if fleet_bundles:
+        lines.append(
+            "- fleet incident bundles: "
+            + " · ".join(f"`incidents/{name}/host<k>/`" for name in fleet_bundles)
+        )
+        lines.append("")
+    return lines
+
+
 # ----------------------------------------------------------------- report
 
 
 def build_report(checkpoint_dir: str) -> str:
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     metrics = _load_jsonl(os.path.join(checkpoint_dir, "metrics.jsonl"))
-    spans = _load_jsonl(os.path.join(checkpoint_dir, "spans.jsonl"))
+    # Fleet-aware span load: merges spans.host<k>.jsonl lanes (clock-aligned,
+    # host-prefixed tids) when graftfleet ran; falls back to the plain
+    # spans.jsonl events unchanged otherwise.
+    from trlx_tpu.observability.spans import read_fleet_spans
+
+    spans = read_fleet_spans(checkpoint_dir)["traceEvents"]
     scalars = _scalar_records(metrics)
     lines = [f"# Performance report — `{checkpoint_dir}`", ""]
 
@@ -400,6 +489,9 @@ def build_report(checkpoint_dir: str) -> str:
     # --- graftscope: device-time attribution & time sinks -----------------
     lines += _graftscope_section(checkpoint_dir)
 
+    # --- graftfleet: cross-host federation --------------------------------
+    lines += _fleet_section(checkpoint_dir)
+
     # --- training health --------------------------------------------------
     incidents_dir = os.path.join(checkpoint_dir, "incidents")
     bundles = sorted(os.listdir(incidents_dir)) if os.path.isdir(incidents_dir) else []
@@ -512,7 +604,12 @@ def main(argv=None):
         print(report)
 
     if args.trace_out:
-        spans = _load_jsonl(os.path.join(os.path.abspath(args.checkpoint_dir), "spans.jsonl"))
+        # Fleet-aware: merges spans.host<k>.jsonl into clock-aligned per-host
+        # lanes when graftfleet ran; identical to the plain spans.jsonl dump
+        # otherwise.
+        from trlx_tpu.observability.spans import read_fleet_spans
+
+        spans = read_fleet_spans(os.path.abspath(args.checkpoint_dir))["traceEvents"]
         with open(args.trace_out, "w") as f:
             json.dump({"traceEvents": spans}, f)
         print(f"wrote {args.trace_out} ({len(spans)} events)")

@@ -37,6 +37,8 @@ Event vocabulary (the Chrome trace-event format's subset we emit):
   lazily at the thread's first event, so lanes carry the ``trlx-*`` names.
 """
 
+import os
+import re
 import threading
 import time
 import warnings
@@ -51,10 +53,33 @@ __all__ = [
     "complete",
     "instant",
     "read_spans",
+    "read_fleet_spans",
+    "host_spans_filename",
     "SPANS_FILENAME",
+    "FLEET_CLOCK_FILENAME",
+    "TID_STRIDE",
 ]
 
 SPANS_FILENAME = "spans.jsonl"
+# graftfleet clock-offset history (trlx_tpu/observability/fleet.py appends
+# one record per estimate); read_fleet_spans applies the last record's
+# per-host offsets when merging lanes.
+FLEET_CLOCK_FILENAME = "fleet_clock.jsonl"
+# Per-host tid remap stride for the merged fleet trace: synthetic tids are
+# small thread counters (a handful per host), so host k's lane t becomes
+# k * TID_STRIDE + t and overlapping tids across hosts can never collide
+# even if a file's pid tags are missing or wrong.
+TID_STRIDE = 1000
+
+_HOST_SPANS_RE = re.compile(r"^spans\.host(\d+)\.jsonl$")
+
+
+def host_spans_filename(process_index: int) -> str:
+    """Per-host spans file for fleet federation: ``spans.host<k>.jsonl``.
+    Unlike the shared SPANS_FILENAME (every host appends to one file), one
+    file per host survives a non-shared filesystem and lets the merge
+    reader tolerate a torn tail PER HOST."""
+    return f"spans.host{int(process_index)}.jsonl"
 
 
 class _NullSpan:
@@ -235,3 +260,89 @@ def read_spans(path: str):
     utils.jsonl contract (a killed writer tears at most the tail; mid-file
     corruption still raises)."""
     return jsonl.read_jsonl(path)
+
+
+def _last_clock_record(checkpoint_dir: str):
+    """Freshest clock-offset record (or None): fleet_clock.jsonl is an
+    append-only history, last line wins. Torn tails are routine post-kill."""
+    path = os.path.join(checkpoint_dir, FLEET_CLOCK_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        records = jsonl.read_jsonl(path)
+    return records[-1] if records else None
+
+
+def read_fleet_spans(checkpoint_dir: str) -> dict:
+    """Merge every host's span file into ONE Chrome trace with per-host
+    process lanes and a stated clock-alignment bound.
+
+    - ``spans.host<k>.jsonl`` files (graftfleet armed) are each read with
+      per-file torn-tail tolerance; a plain ``spans.jsonl`` (fleet off, or a
+      pre-fleet run) merges as whatever pids its events carry.
+    - Every event from host k is forced onto pid k with its tid remapped to
+      ``k * TID_STRIDE + tid`` — overlapping synthetic tids across hosts can
+      never collide in the merged view.
+    - When a ``fleet_clock.jsonl`` estimate exists, host k's timestamps are
+      shifted by −offset_k into host 0's clock frame, and each host lane's
+      process_name states its offset and the alignment-error bound
+      (estimate uncertainty + drift bound — see fleet.py).
+
+    Returns ``{"traceEvents": [...], "hosts": [...], "clock": {...} | None,
+    "alignment_error_s": float}``.
+    """
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    files = []  # (host_index or None, path)
+    try:
+        names = sorted(os.listdir(checkpoint_dir))
+    except OSError:
+        names = []
+    for name in names:
+        m = _HOST_SPANS_RE.match(name)
+        if m:
+            files.append((int(m.group(1)), os.path.join(checkpoint_dir, name)))
+    if not files and SPANS_FILENAME in names:
+        files.append((None, os.path.join(checkpoint_dir, SPANS_FILENAME)))
+
+    clock = _last_clock_record(checkpoint_dir)
+    offsets = list(clock.get("offsets_s", [])) if clock else []
+    bound = 0.0
+    if clock:
+        bound = float(clock.get("uncertainty_s", 0.0)) + float(clock.get("drift_s", 0.0))
+
+    events, hosts = [], []
+    for host, path in sorted(files, key=lambda kv: (kv[0] is None, kv[0])):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # torn tails tolerated PER FILE
+            try:
+                host_events = jsonl.read_jsonl(path)
+            except (OSError, ValueError):
+                continue
+        if host is None:
+            # Legacy shared file: trust the recorded pids, no remap.
+            events.extend(host_events)
+            hosts.extend(sorted({e.get("pid", 0) for e in host_events}))
+            continue
+        hosts.append(host)
+        shift_us = int(offsets[host] * 1e6) if host < len(offsets) else 0
+        for event in host_events:
+            event = dict(event)
+            event["pid"] = host
+            if "tid" in event:
+                event["tid"] = host * TID_STRIDE + int(event["tid"])
+            if shift_us and "ts" in event:
+                event["ts"] = int(event["ts"]) - shift_us
+            events.append(event)
+        label = f"host{host}"
+        if host < len(offsets):
+            label += f" (clock offset {offsets[host] * 1e3:+.3f}ms ± {bound * 1e3:.3f}ms)"
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": host, "args": {"name": label}}
+        )
+    return {
+        "traceEvents": events,
+        "hosts": sorted(set(hosts)),
+        "clock": clock,
+        "alignment_error_s": bound,
+    }

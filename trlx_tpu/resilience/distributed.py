@@ -93,6 +93,11 @@ class Heartbeat:
         self.step = 0
         self.phase = "init"
         self.progress_t = time.time()
+        # Monotonic twin of progress_t: wall clocks across hosts can step
+        # (NTP slews), so graftfleet's skew estimation needs both bases in
+        # the payload — wall for cross-host comparison, monotonic for
+        # drift-proof ages on this host.
+        self.progress_mono = time.monotonic()
         self._stop = threading.Event()
         self._thread = None
 
@@ -108,6 +113,7 @@ class Heartbeat:
             if phase is not None:
                 self.phase = phase
             self.progress_t = time.time()
+            self.progress_mono = time.monotonic()
 
     def _write(self):
         with self._beat_lock:
@@ -118,7 +124,9 @@ class Heartbeat:
                     "step": self.step,
                     "phase": self.phase,
                     "progress_t": self.progress_t,
+                    "progress_mono": self.progress_mono,
                     "written_t": time.time(),
+                    "written_mono": time.monotonic(),
                 }
             )
         atomic_write_text(self.path, payload)
@@ -280,6 +288,19 @@ class collective_guard:
             )
         except Exception:  # noqa: BLE001 — the abort path must still abort
             pass
+        try:
+            # Fleet forensics (graftfleet armed): every reachable host's span
+            # tail + heartbeat record into incidents/<step>/host<k>/ — the
+            # wedged peer can't dump, so THIS host collects from the shared
+            # checkpoint dir. One dict load when disarmed.
+            from trlx_tpu.observability import fleet as _obs_fleet
+
+            _obs_fleet.incident_bundle(
+                step, "collective_timeout",
+                detail={"collective": self.name, "deadline_s": self.deadline},
+            )
+        except Exception:  # noqa: BLE001 — the abort path must still abort
+            pass
         hb = _CONFIG["heartbeat"]
         detail = (
             stall_report(hb.directory, self.name)
@@ -297,6 +318,13 @@ class collective_guard:
 
     def __enter__(self):
         self._span_t0 = None
+        self._fleet_t0 = None
+        # Fleet arrival stamp BEFORE the deadline gate: straggler attribution
+        # works even on guards left at deadline 0. One dict load disarmed.
+        from trlx_tpu.observability import fleet as _obs_fleet
+
+        if _obs_fleet.armed():
+            self._fleet_t0 = time.time()
         if self.deadline <= 0:
             return self
         from trlx_tpu.observability import spans as _obs_spans
@@ -317,6 +345,14 @@ class collective_guard:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self._fleet_t0 is not None:
+            from trlx_tpu.observability import fleet as _obs_fleet
+
+            # Per-host arrival record for this (site, seq) occurrence — the
+            # cross-host skew join happens at read time over the shared
+            # checkpoint dir, so no collective rides on the hot path.
+            _obs_fleet.collective_complete(self.name, self._fleet_t0, time.time())
+            self._fleet_t0 = None
         if self._span_t0 is not None:
             from trlx_tpu.observability import spans as _obs_spans
 

@@ -38,6 +38,7 @@ from transformers import AutoTokenizer
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import trainable_mask
 from trlx_tpu import observability as obs
+from trlx_tpu.observability import fleet as obs_fleet
 from trlx_tpu.observability import graftscope as obs_graftscope
 from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
@@ -246,9 +247,24 @@ class JaxBaseTrainer(BaseRLTrainer):
         # timeline) needs both the fence hook in DeviceMonitor and the spans
         # file for its timeline rows, so arming it implies arming those two.
         graftscope_on = config.train.graftscope or obs.env_flag("TRLX_TPU_GRAFTSCOPE")
-        if config.train.trace_spans or graftscope_on or obs.env_flag("TRLX_TPU_SPANS"):
+        # graftfleet (cross-host federation) owns the span filename when
+        # armed: each host writes spans.host<k>.jsonl so read_fleet_spans can
+        # merge per-host lanes. Arming it implies span tracing (the merged
+        # trace and the incident span tails are its artifacts).
+        fleet_on = config.train.graftfleet or obs.env_flag("TRLX_TPU_GRAFTFLEET")
+        if (
+            config.train.trace_spans
+            or graftscope_on
+            or fleet_on
+            or obs.env_flag("TRLX_TPU_SPANS")
+        ):
             obs_spans.configure(
-                os.path.join(ckpt_dir, obs_spans.SPANS_FILENAME),
+                os.path.join(
+                    ckpt_dir,
+                    obs_spans.host_spans_filename(jax.process_index())
+                    if fleet_on
+                    else obs_spans.SPANS_FILENAME,
+                ),
                 process_index=jax.process_index(),
             )
         else:
@@ -307,6 +323,25 @@ class JaxBaseTrainer(BaseRLTrainer):
                     os.path.join(ckpt_dir, "lineage.jsonl") if is_main_process() else None
                 ),
             )
+        # graftfleet monitor: records guarded-collective arrivals (via the
+        # collective_guard exit hook), estimates the cross-host clock
+        # alignment, and (process 0) rolls the fleet gauges / healthz block
+        # at log boundaries. Construction-owned like the span tracer; the
+        # startup clock_sync is collective, so the knob must be
+        # config-consistent across hosts.
+        self._fleet = None
+        if fleet_on:
+            self._fleet = obs_fleet.configure(
+                ckpt_dir,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                resync_interval=config.train.fleet_resync_interval,
+            )
+            self._fleet.clock_sync(step=0)
+            if self._health is not None:
+                self._health.register_detector(self._fleet.straggler)
+        else:
+            obs_fleet.shutdown()
         # Live /metrics + /healthz endpoint (trlx_tpu/observability/
         # export.py): process 0 only, armed by the port knob. The port is
         # recorded on EVERY process — multi-host gauge rollup needs all
@@ -318,7 +353,12 @@ class JaxBaseTrainer(BaseRLTrainer):
         if self._metrics_port > 0 and is_main_process():
             from trlx_tpu.observability.export import MetricsExporter
 
-            self._metrics_exporter = MetricsExporter(self._metrics_port)
+            # port_file: where a scraper finds the ACTUAL port when the
+            # requested one was busy and the exporter rebound ephemerally.
+            self._metrics_exporter = MetricsExporter(
+                self._metrics_port,
+                port_file=os.path.join(ckpt_dir, "metrics_port"),
+            )
 
         self.reward_fn = kwargs.pop("reward_fn", None)
         self.metric_fn = kwargs.pop("metric_fn", None)
@@ -434,7 +474,13 @@ class JaxBaseTrainer(BaseRLTrainer):
         if jax.process_count() > 1:
             from trlx_tpu.observability.report import rollup_window_stats
 
-            gauges.update(rollup_window_stats(gauges))
+            # per_host only when graftfleet armed: the per-host labeled rows
+            # multiply the gauge count by process_count, and fleet triage is
+            # what wants them. The flag is config-consistent across hosts, so
+            # the gather shape stays aligned.
+            gauges.update(
+                rollup_window_stats(gauges, per_host=self._fleet is not None)
+            )
         if self._metrics_exporter is not None:
             health = getattr(self, "_health", None)
             self._metrics_exporter.update(
@@ -725,6 +771,13 @@ class JaxBaseTrainer(BaseRLTrainer):
             )
         if "obs/bubble_fraction" in merged:
             parts.append("bub={:.0%}".format(merged["obs/bubble_fraction"]))
+        fl = getattr(self, "_fleet", None)
+        if fl is not None and jax.process_count() > 1:
+            # Fleet readout: host count + the last window's worst aligned
+            # collective skew (graftfleet's straggler signal at a glance).
+            parts.append(
+                f"hosts={jax.process_count()} skew={fl.last_skew_ms:.0f}ms"
+            )
         # \x1b[K clears to end-of-line so a previous longer line (e.g. one
         # with eval-only keys) leaves no remnants after the rewrite.
         print("  ".join(parts) + "\x1b[K", end="\r", file=sys.stderr, flush=True)
@@ -963,6 +1016,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                 self._devicemon.ledger = None
                 obs_graftscope.shutdown()
                 self._graftscope = None
+            if self._fleet is not None:
+                # Closes the arrival-record file (no thread to join); the
+                # fleet artifacts stay on disk for read_fleet_spans and the
+                # report's Fleet section.
+                obs_fleet.shutdown()
+                self._fleet = None
             if self._metrics_exporter is not None:
                 # Exporter last: it only serves snapshots, so scrapers get
                 # the final gauge state right up to teardown.
@@ -1227,6 +1286,17 @@ class JaxBaseTrainer(BaseRLTrainer):
                                 self.tracker, self.iter_count
                             )
                         self._export_metrics(stats_host)
+                        if self._fleet is not None:
+                            # Fleet window rollup AFTER _export_metrics'
+                            # collective gather: the fleet/* keys exist only
+                            # on process 0, and mismatched key sets across
+                            # hosts would misalign the rollup's allgather.
+                            stats_host.update(
+                                self._fleet.on_log_boundary(
+                                    self.iter_count,
+                                    exporter=self._metrics_exporter,
+                                )
+                            )
                         self.tracker.log(stats_host, step=self.iter_count)
                         self.progress_line(stats_host)
                         self._last_log_t = time.time()
@@ -1245,6 +1315,13 @@ class JaxBaseTrainer(BaseRLTrainer):
                     di = self.config.train.desync_check_interval
                     if di and self.iter_count % di == 0:
                         self._check_desync()
+
+                    # graftfleet clock resync: two tiny guarded allgathers
+                    # every train.fleet_resync_interval steps — collective,
+                    # keyed on iter_count so every host enters at the
+                    # identical step.
+                    if self._fleet is not None:
+                        self._fleet.maybe_resync(self.iter_count)
 
                     # Mid-batch reaction is single-process by default: a
                     # per-step agreement collective would tax the hot loop,
@@ -1414,9 +1491,24 @@ class JaxBaseTrainer(BaseRLTrainer):
         coordinated abort, never a one-sided hang."""
         if jax.process_count() == 1:
             return
-        dist_res.verify_fingerprints(
-            dist_res.host_fingerprint(self.iter_count, self.state.params, rng=self.rng)
+        fingerprint = dist_res.host_fingerprint(
+            self.iter_count, self.state.params, rng=self.rng
         )
+        fleet = getattr(self, "_fleet", None)
+        if fleet is not None:
+            # Cache BEFORE the verify: on a desync abort the bundle must
+            # show the fingerprint this host brought to the comparison.
+            fleet.note_fingerprint(self.iter_count, fingerprint)
+        try:
+            dist_res.verify_fingerprints(fingerprint)
+        except dist_res.HostDesync as e:
+            if fleet is not None:
+                fleet.incident_bundle(
+                    self.iter_count, "host_desync", detail=str(e)
+                )
+            raise
+        if fleet is not None:
+            fleet.note_desync(self.iter_count, ok=True)
 
     def _rollback(self):
         """Divergence watchdog response: restore the last intact checkpoint,
