@@ -20,9 +20,16 @@ with every observability surface armed:
 - reporting: trlx_tpu.observability.report must render every section from
   the run's artifacts and export the chrome://tracing JSON.
 
+Two follow-up probes ride along: ``graftscope_probe`` (PR 12 — ledger
+conservation, slot timeline, crash-proof manifest) and ``numerics_probe``
+(PR 15 — an armed graftnum run under the ``nan_layer@2`` drill whose
+incident bundle names the injected layer, with ``num/*`` gauges on the
+live scrape and a rendered Numerics report section; writes
+OBS_NUMERICS.json).
+
 Writes OBS_SMOKE.json + OBS_REPORT.md + OBS_METRICS.prom (the last live
 scrape) and prints one JSON summary line; exits 1 on any failure. Wall
-time ~1 min on a laptop CPU.
+time ~2 min on a laptop CPU.
 """
 
 import json
@@ -426,10 +433,126 @@ def graftscope_probe():
     }
 
 
+def numerics_probe():
+    """PR 15 smoke: an armed overlapped graftnum run under the nan_layer
+    drill must stream num/* gauges to the LIVE /metrics endpoint, attach a
+    numerics.json to the guard-skip incident bundle that names the injected
+    layer as first-NaN (plus the nonfinite grad leaves by path), and render
+    the report's Numerics section. Writes OBS_NUMERICS.json."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    # nan_layer@2: step 2's batch is NaN-poisoned (guard trips for real) AND
+    # the bisector's injection target block_2 is latched — so the model needs
+    # n_layer > 2 for the clamp min(2, n_layer-1) to name a distinct layer.
+    os.environ["TRLX_TPU_FAULTS"] = "nan_layer@2"
+    os.environ.pop("TRLX_TPU_SLOW_STEP_SECONDS", None)
+    os.environ["TRLX_TPU_PEAK_TFLOPS"] = "0.01"
+
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    import trlx_tpu
+    from randomwalks import base_config, generate_random_walks
+    from trlx_tpu.observability import report
+
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.model.model_arch["n_layer"] = 4
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.graftnum = True
+    port = _free_port()
+    config.train.metrics_port = port
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 8
+    config.method.max_staleness = 1
+    d = tempfile.mkdtemp(prefix="obs_smoke_num_")
+    config.train.checkpoint_dir = d
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+
+    scraper = _Scraper(port)
+    t0 = time.time()
+    try:
+        model = trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            eval_prompts=[[1]],
+            metric_fn=metric_fn,
+            config=config,
+            logit_mask=logit_mask,
+        )
+    finally:
+        wall_s = time.time() - t0
+        scraper.stop()
+        os.environ.pop("TRLX_TPU_FAULTS", None)
+    assert model.iter_count >= 8
+    assert model.skipped_steps >= 1, "nan_layer drill never tripped the guard"
+    leaked = [t.name for t in threading.enumerate() if t.name.startswith("trlx-")]
+    assert not leaked, f"pipeline threads leaked: {leaked}"
+
+    # --- num/* telemetry in metrics.jsonl ---------------------------------
+    with open(os.path.join(d, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    gnorm = [r["num/grad_global_norm"] for r in records if "num/grad_global_norm" in r]
+    assert gnorm, "no num/grad_global_norm records"
+    subtree_keys = sorted(
+        {k for r in records for k in r if k.startswith("num/update_ratio/")}
+    )
+    assert subtree_keys, "no per-subtree update-ratio gauges"
+
+    # --- num/* gauges on the LIVE /metrics scrape -------------------------
+    assert scraper.scrapes > 0, "never scraped the live /metrics endpoint"
+    prom = scraper.metrics_text
+    assert "trlx_tpu_num_grad_global_norm" in prom, prom[:2000]
+    assert "trlx_tpu_num_update_ratio_" in prom
+
+    # --- incident bundle: numerics.json names the injected layer ----------
+    incidents_dir = os.path.join(d, "incidents")
+    payload = None
+    for b in sorted(os.listdir(incidents_dir) if os.path.isdir(incidents_dir) else []):
+        p = os.path.join(incidents_dir, b, "numerics.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                payload = json.load(f)
+            break
+    assert payload is not None, "no numerics.json in any incident bundle"
+    census = payload["grad_census"]
+    assert census["total_nonfinite_leaves"] > 0, census
+    bisect = payload["forward_bisect"]
+    assert bisect["first_nonfinite"] == "block_2", bisect
+    assert bisect["injected"] == "block_2", bisect
+
+    # --- report renders the Numerics section ------------------------------
+    md = report.build_report(d)
+    assert "## Numerics (graftnum)" in md, "Numerics section missing from report"
+    assert "block_2" in md and "nonfinite grad leaves" in md
+
+    out = {
+        "steps": model.iter_count,
+        "skipped_steps": model.skipped_steps,
+        "grad_norm_records": len(gnorm),
+        "subtree_gauges": len(subtree_keys),
+        "first_nonfinite": bisect["first_nonfinite"],
+        "nonfinite_grad_leaves": census["total_nonfinite_leaves"],
+        "leaf_paths": [e["path"] for e in census["nonfinite_leaves"][:4]],
+        "live_scrapes": scraper.scrapes,
+        "seconds": round(wall_s, 2),
+    }
+    with open(os.path.join(REPO, "OBS_NUMERICS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
 def main():
     t0 = time.time()
     result = {"observability": observability_probe()}
     result["graftscope"] = graftscope_probe()
+    result["numerics"] = numerics_probe()
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)

@@ -1,0 +1,530 @@
+"""graftnum streaming numerics observatory (trlx_tpu/observability/numerics.py).
+
+Unit tier: the disarmed probe tap's trace-transparency (identical jaxpr —
+the byte-identity contract), per-subtree reduction parity against a naive
+host loop, the nonfinite census naming the exact poisoned leaf, the
+first-NaN forward bisector on both a synthetic tap chain and a real tiny
+TransformerLM, quantization-error gauges across two engine
+``update_weights`` versions, the grad-spike / update-ratio detectors'
+hysteresis walks, the no-monitor CRIT escalation, and GL007-style
+sanitize-mirror conformance of every emitted ``num/*`` key.
+
+Integration tier (CPU): the PR's acceptance run — an armed PPO run under
+``TRLX_TPU_FAULTS=nan_layer@2`` whose guard-skip incident bundle carries a
+``numerics.json`` naming the injected layer as first-NaN and the nonfinite
+grad leaves by path; and the disarmed satellite — ``nan_grad`` with
+graftnum OFF still gets a census-only ``numerics.json`` (the default-on
+guard finally names its culprit) while metrics.jsonl stays free of any
+``num/*`` residue.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.models import LMConfig, LMWithValueHead  # noqa: E402
+from trlx_tpu.models.lm import quantize_kv, quantize_weights  # noqa: E402
+from trlx_tpu.observability import anomaly as obs_anomaly  # noqa: E402
+from trlx_tpu.observability import numerics as obs_numerics  # noqa: E402
+from trlx_tpu.observability import report  # noqa: E402
+from trlx_tpu.observability import spans as obs_spans  # noqa: E402
+from trlx_tpu.observability.export import _VALID, sanitize_metric_name  # noqa: E402
+from trlx_tpu.observability.health import CRIT, OK, WARN  # noqa: E402
+from trlx_tpu.observability.numerics import (  # noqa: E402
+    GradNormSpikeDetector,
+    UpdateRatioDetector,
+    bisect_forward,
+    nonfinite_census,
+    param_subtrees,
+    probe_tap,
+    train_step_stats,
+)
+
+
+@pytest.fixture(autouse=True)
+def _numerics_isolation():
+    """graftnum state is process-global (trainer construction owns it) —
+    always disarm after each test so gauges, latched injections, and the
+    emergency hook never leak into a later run."""
+    yield
+    obs_numerics.shutdown()
+    obs_spans.shutdown()
+    obs_anomaly.register_emergency(None)
+
+
+def _tiny_model(**overrides):
+    cfg = LMConfig(
+        vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=64,
+        dtype="float32", **overrides,
+    )
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 6), 2, cfg.vocab_size)
+    mask = jnp.ones((2, 6), jnp.int32)
+    params = {"params": model.init(rng, ids, mask)["params"]}
+    return model, params, ids, mask
+
+
+# --------------------------------------------------------- disarmed contract
+
+
+def test_disarmed_tap_is_trace_transparent():
+    """The byte-identity contract: with no armed session, probe_tap is the
+    identity at trace time — the jaxpr of a tapped function is EXACTLY the
+    jaxpr of the untapped one, so a disarmed run compiles the pre-graftnum
+    program."""
+    x = jnp.ones((3, 4), jnp.float32)
+    tapped = jax.make_jaxpr(lambda a: probe_tap("block_0", a) * 2.0 + 1.0)(x)
+    plain = jax.make_jaxpr(lambda a: a * 2.0 + 1.0)(x)
+    assert str(tapped) == str(plain)
+    # And eagerly, the disarmed tap returns the very same object.
+    assert probe_tap("embed", x) is x
+
+
+def test_armed_resolves_config_or_env(monkeypatch):
+    class T:
+        graftnum = False
+
+    monkeypatch.delenv("TRLX_TPU_GRAFTNUM", raising=False)
+    assert not obs_numerics.armed(T())
+    T.graftnum = True
+    assert obs_numerics.armed(T())
+    T.graftnum = False
+    monkeypatch.setenv("TRLX_TPU_GRAFTNUM", "1")
+    assert obs_numerics.armed(T())
+    monkeypatch.setenv("TRLX_TPU_GRAFTNUM", "0")
+    assert not obs_numerics.armed(T())
+
+
+# ------------------------------------------------------ reduction parity
+
+
+def test_train_step_stats_parity_vs_naive_host_loop():
+    rng = np.random.default_rng(7)
+
+    def leaf(*shape):
+        return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+    params = {
+        "policy": {
+            "h_0": {"w": leaf(4, 4), "b": leaf(4)},
+            "wte": {"embedding": leaf(9, 4)},
+        },
+        "value_head": {"kernel": leaf(4, 1)},
+    }
+    grads = jax.tree_util.tree_map(lambda p: p * 0.1 + 0.3, params)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - 0.01 * g, params, grads)
+
+    stats = {k: float(v) for k, v in train_step_stats(grads, params, new_params).items()}
+
+    def host_norm(tree):
+        return float(
+            np.sqrt(
+                sum(float(np.sum(np.asarray(a) ** 2)) for a in jax.tree_util.tree_leaves(tree))
+            )
+        )
+
+    subs = param_subtrees(grads)
+    assert set(subs) == {"policy/h_0", "policy/wte", "value_head/kernel"}
+    for name in subs:
+        g = host_norm(param_subtrees(grads)[name])
+        p = host_norm(param_subtrees(params)[name])
+        d = host_norm(
+            jax.tree_util.tree_map(
+                lambda a, b: np.asarray(a) - np.asarray(b),
+                param_subtrees(new_params)[name],
+                param_subtrees(params)[name],
+            )
+        )
+        np.testing.assert_allclose(stats[f"num/grad_norm/{name}"], g, rtol=1e-5)
+        np.testing.assert_allclose(stats[f"num/param_norm/{name}"], p, rtol=1e-5)
+        np.testing.assert_allclose(
+            stats[f"num/update_ratio/{name}"], d / (p + 1e-12), rtol=1e-5
+        )
+    np.testing.assert_allclose(stats["num/grad_global_norm"], host_norm(grads), rtol=1e-5)
+
+
+def test_train_step_stats_is_jit_safe():
+    params = {"g": {"w": jnp.ones((3, 3))}}
+    grads = {"g": {"w": jnp.full((3, 3), 2.0)}}
+
+    @jax.jit
+    def step(g, p):
+        return train_step_stats(g, p, jax.tree_util.tree_map(lambda a: a * 0.5, p))
+
+    out = step(grads, params)
+    assert float(out["num/grad_global_norm"]) == pytest.approx(6.0)
+    assert float(out["num/update_ratio/g/w"]) == pytest.approx(0.5, rel=1e-5)
+
+
+# ----------------------------------------------------------------- census
+
+
+def test_census_names_exact_poisoned_leaf():
+    tree = {
+        "policy": {
+            "h_0": {"kernel": jnp.ones((4, 4))},
+            "h_1": {"kernel": jnp.ones((4, 4)).at[1, 2].set(jnp.nan)},
+        },
+        "ids": jnp.ones((3,), jnp.int32),  # integer leaves are skipped
+    }
+    census = nonfinite_census(tree)
+    assert census["total_nonfinite_leaves"] == 1
+    (entry,) = census["nonfinite_leaves"]
+    assert entry["path"].endswith("h_1/kernel")
+    assert entry["nan"] == 1 and entry["inf"] == 0 and entry["size"] == 16
+
+
+def test_census_caps_named_leaves_but_counts_all():
+    tree = {f"g_{i}": jnp.full((2,), jnp.inf) for i in range(40)}
+    census = nonfinite_census(tree, max_leaves=5)
+    assert census["total_nonfinite_leaves"] == 40
+    assert len(census["nonfinite_leaves"]) == 5
+    assert all(e["inf"] == 2 for e in census["nonfinite_leaves"])
+
+
+# --------------------------------------------------------------- bisector
+
+
+def test_bisect_synthetic_chain_names_injected_tap():
+    seen = []
+
+    def forward():
+        x = jnp.ones((2, 3))
+        for i in range(3):
+            x = probe_tap(f"block_{i}", x * 1.5)
+            seen.append(float(jnp.sum(x)))
+
+    out = bisect_forward(forward, inject="block_1")
+    assert out["first_nonfinite"] == "block_1"
+    assert out["injected"] == "block_1"
+    names = [t["tap"] for t in out["taps"]]
+    assert names == ["block_0", "block_1", "block_2"]
+    assert out["taps"][0]["nan"] == 0
+    assert out["taps"][1]["nan"] == out["taps"][1]["size"] == 6
+    # the session is torn down — later taps are identity again
+    x = jnp.ones(())
+    assert probe_tap("block_1", x) is x
+
+
+def test_bisect_clean_forward_and_error_capture():
+    assert bisect_forward(lambda: probe_tap("a", jnp.ones(())))["first_nonfinite"] is None
+
+    def boom():
+        probe_tap("a", jnp.ones(()))
+        raise RuntimeError("mid-forward assert")
+
+    out = bisect_forward(boom)
+    assert out["first_nonfinite"] is None
+    assert out["taps"][-1]["tap"] == "<error>"
+    assert "mid-forward assert" in out["taps"][-1]["error"]
+
+
+def test_bisect_real_model_names_injected_layer():
+    """The taps models/lm.py registers (embed -> block_<i> -> ln_f) fire in
+    an EAGER apply, and injecting at block_1 names exactly block_1 — the
+    ground truth the nan_layer drill asserts end-to-end."""
+    model, params, ids, mask = _tiny_model()
+    out = bisect_forward(lambda: model.apply(params, ids, mask), inject="block_1")
+    names = [t["tap"] for t in out["taps"]]
+    assert names[:2] == ["embed", "block_0"] and "block_1" in names and "ln_f" in names
+    assert out["first_nonfinite"] == "block_1"
+    by_name = {t["tap"]: t for t in out["taps"]}
+    assert by_name["embed"]["nan"] == 0 and by_name["block_0"]["nan"] == 0
+    assert by_name["block_1"]["nan"] > 0 and by_name["ln_f"]["nan"] > 0
+
+
+def test_injection_latch_is_one_shot():
+    obs_numerics.latch_injection("block_3")
+    assert obs_numerics.consume_injection() == "block_3"
+    assert obs_numerics.consume_injection() is None
+
+
+# ------------------------------------------------------ quantization error
+
+
+def test_quant_probe_accumulates_and_gauges_are_sane():
+    rng = np.random.default_rng(3)
+    params = {
+        "h_0": {"attn": {"c_qkv": {"kernel": jnp.asarray(rng.normal(size=(8, 24)), jnp.float32)}}},
+        "mlp": {"c_fc": {"kernel": jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)}},
+    }
+    probe = {}
+    qw = quantize_weights(params, probe=probe)
+    assert set(probe) == {"c_qkv", "c_fc"}
+    assert qw["h_0"]["attn"]["c_qkv"]["kernel_q"].dtype == jnp.int8
+    gauges = obs_numerics._quant_gauges(probe, version=4)
+    assert gauges["num/quant_weight_version"] == 4.0
+    for cls in ("c_qkv", "c_fc"):
+        assert 0.0 < gauges[f"num/quant_err_rms/{cls}"] < 1.0  # int8 round trip
+        assert gauges[f"num/quant_err_max/{cls}"] >= gauges[f"num/quant_err_rms/{cls}"]
+        assert 20.0 < gauges[f"num/quant_snr_db/{cls}"] <= 200.0
+
+    kv_probe = {}
+    quantize_kv(jnp.asarray(rng.normal(size=(1, 4, 2, 8)), jnp.float32), probe=kv_probe, probe_class="kv")
+    kv_gauges = obs_numerics._quant_gauges(kv_probe)
+    assert kv_gauges["num/quant_err_rms/kv"] > 0.0
+
+
+def test_quant_probe_default_none_keeps_trace_identical():
+    params = {"h_0": {"c_proj": {"kernel": jnp.ones((4, 4))}}}
+    with_probe = jax.make_jaxpr(lambda p: quantize_weights(p))(params)
+    plain = jax.make_jaxpr(quantize_weights)(params)
+    assert str(with_probe) == str(plain)
+
+
+def test_quant_gauges_across_two_engine_weight_versions():
+    """The engine-path satellite: two ``update_weights`` handoffs refresh
+    the armed observatory's gauges with the new version tag, and perturbing
+    the weights MOVES the error gauges (it is a live probe, not a cached
+    constant)."""
+    from trlx_tpu.engine import RolloutEngine
+    from trlx_tpu.ops.sampling import GenerateConfig
+
+    model, params, _, _ = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, pad_token_id=0)
+    engine = RolloutEngine(model, gcfg, n_slots=2, prompt_width=4)
+    obs_numerics.configure()
+    try:
+        engine.update_weights(params, version=0)
+        g0 = obs_numerics.instance().gauges()
+        rms_keys = [k for k in g0 if k.startswith("num/quant_err_rms/")]
+        assert rms_keys, g0
+        assert g0["num/quant_weight_version"] == 0.0
+        assert any(k.startswith("num/quant_snr_db/kv") for k in g0)  # embedding proxy
+
+        bigger = jax.tree_util.tree_map(lambda a: a * 3.0, params)
+        engine.update_weights(bigger, version=1)
+        g1 = obs_numerics.instance().gauges()
+        assert g1["num/quant_weight_version"] == 1.0
+        assert any(g1[k] != g0[k] for k in rms_keys)
+    finally:
+        engine.shutdown()
+
+
+def test_record_functions_are_noops_when_disarmed():
+    model, params, _, _ = _tiny_model()
+    assert obs_numerics.record_weight_quant(params["params"]) == {}
+    assert obs_numerics.record_weight_handoff(params, version=1) == {}
+    assert not obs_numerics.enabled()
+
+
+# -------------------------------------------------------------- detectors
+
+
+def test_grad_spike_detector_walks_warn_then_crit():
+    d = GradNormSpikeDetector(warn_factor=3.0, crit_factor=10.0, warmup=4,
+                              warn_streak=1, crit_streak=2)
+    for _ in range(6):
+        assert d.observe(1.0) == OK  # clean baseline, p50 = 1.0
+    assert d.observe(5.0) == WARN  # 3x < 5 < 10x
+    assert d.observe(50.0) == WARN  # crit streak 1 of 2
+    assert d.observe(50.0) == CRIT
+    # spikes never entered the baseline: p50 still the clean 1.0
+    assert d.p50() == pytest.approx(1.0)
+    # nonfinite observation is CRIT-severity on its own
+    assert d.severity(float("nan")) == 2
+
+
+def test_grad_spike_detector_warmup_suppresses_judgment():
+    d = GradNormSpikeDetector(warmup=5, warn_streak=1, crit_streak=1)
+    for v in (1.0, 100.0, 1.0, 100.0):  # fewer than warmup clean obs seeded
+        assert d.severity(v) in (0,) or len(d._history) < 5
+
+
+def test_update_ratio_detector_bands():
+    d = UpdateRatioDetector(lo=1e-6, hi=1e-2, warmup=1, warn_streak=1, crit_streak=2)
+    ok = {"a": 1e-4, "b": 1e-3, "c": 1e-3}
+    assert d.observe(ok) == OK  # warmup observation
+    assert d.observe(ok) == OK  # in-band
+    assert d.observe({**ok, "a": 5e-2}) == WARN  # one subtree of three hot
+    assert d.observe({**ok, "a": 5e-1}) == WARN  # extreme: crit streak 1 of 2
+    assert d.observe({**ok, "a": 5e-1}) == CRIT
+    # a wholly stalled step (all ratios exactly 0 — guard skip) violates
+    d2 = UpdateRatioDetector(warmup=0, warn_streak=1, crit_streak=1)
+    assert d2.observe({"a": 0.0, "b": 0.0}) == CRIT
+
+
+def test_escalate_without_monitor_captures_health_incident():
+    captured = []
+
+    class _FakeCapture:
+        def capture(self, step, reason, detail=None):
+            captured.append((reason, detail))
+
+    obs_anomaly.register_emergency(_FakeCapture())
+    d = GradNormSpikeDetector(warmup=1, warn_streak=1, crit_streak=1)
+    d.on_crit = obs_numerics.escalate
+    d.observe(1.0), d.observe(1.0)
+    d.observe(1e6)
+    assert captured and captured[0][0] == "health_grad_norm_spike"
+    assert captured[0][1]["detector"] == "grad_norm_spike"
+
+
+def test_numerics_instance_feeds_detectors_and_emits_states():
+    inst = obs_numerics.configure()
+    stats = {
+        "num/grad_global_norm": 1.0,
+        "num/update_ratio/policy/h_0": 1e-4,
+        "loss": 0.5,  # unrelated keys ignored
+    }
+    for _ in range(8):
+        inst.observe_train(stats)
+    g = inst.gauges(include_states=True)
+    assert g["health/grad_norm_spike_state"] == 0.0
+    assert g["health/update_ratio_state"] == 0.0
+    assert inst.grad_detector.observations == 8
+    # with include_states=False (a HealthMonitor owns the states) only the
+    # quant gauges remain — empty here
+    assert obs_numerics.instance().gauges(include_states=False) == {}
+
+
+# ------------------------------------------------- sanitize-mirror (GL007)
+
+
+def test_all_num_keys_survive_prometheus_sanitization_without_collisions():
+    """Every key graftnum can emit must sanitize to a UNIQUE legal
+    Prometheus name (the GL007 mirror contract) — a collision would make
+    two gauges silently overwrite each other on /metrics."""
+    params = {"policy": {"h_0": {"w": jnp.ones((2, 2))}}, "head": jnp.ones((2,))}
+    keys = set(train_step_stats(params, params, params))
+    probe = {}
+    quantize_weights(
+        {"h_0": {"c_qkv": {"kernel": jnp.ones((4, 8))}}}, probe=probe
+    )
+    keys |= set(obs_numerics._quant_gauges(probe, version=1))
+    inst = obs_numerics.configure()
+    keys |= set(inst.gauges(include_states=True))
+    assert keys, "no keys collected"
+    sanitized = {}
+    for k in keys:
+        name = sanitize_metric_name(k)
+        assert _VALID.match(name), (k, name)
+        assert name not in sanitized, f"collision: {k} vs {sanitized[name]}"
+        sanitized[name] = k
+
+
+# ------------------------------------------------------------ integration
+
+
+def test_e2e_nan_layer_drill_names_layer_and_leaves(tmp_path, monkeypatch):
+    """The PR's acceptance run: armed PPO under nan_layer@2 on a 4-layer
+    model — the guard genuinely skips step 2, the incident bundle's
+    numerics.json names block_2 as first-NaN (the latched injection) and
+    the nonfinite grad leaves by path, num/* telemetry rides metrics.jsonl,
+    and the report renders the Numerics section."""
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "nan_layer@2")
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.model.model_arch["n_layer"] = 4  # nan_layer@2 targets block_2
+    config.train.total_steps = 4
+    config.train.epochs = 1
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.graftnum = True
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model.skipped_steps >= 1  # the guard really tripped
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+
+    # --- num/* telemetry in metrics.jsonl ---------------------------------
+    with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    scalars = [r for r in records if "num/grad_global_norm" in r]
+    assert scalars, "no num/* telemetry logged"
+    assert any(k.startswith("num/update_ratio/") for k in scalars[-1])
+    assert any(k.startswith("num/grad_norm/") for k in scalars[-1])
+
+    # --- incident bundle carries the provenance artifact ------------------
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    payloads = []
+    for name in sorted(os.listdir(incidents_dir)):
+        p = os.path.join(incidents_dir, name, "numerics.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                payloads.append(json.load(f))
+    assert payloads, "no numerics.json in any incident bundle"
+    payload = payloads[0]
+    census = payload["grad_census"]
+    assert census["total_nonfinite_leaves"] > 0
+    assert all("/" in e["path"] for e in census["nonfinite_leaves"])
+    bisect = payload["forward_bisect"]
+    assert bisect["injected"] == "block_2"
+    assert bisect["first_nonfinite"] == "block_2"
+    taps = {t["tap"]: t for t in bisect["taps"] if "tap" in t}
+    assert taps.get("block_1", {}).get("nan") == 0  # layers BEFORE are clean
+    assert obs_numerics.consume_injection() is None  # latch was consumed
+
+    # --- report renders the section ---------------------------------------
+    md = report.build_report(str(tmp_path))
+    assert "## Numerics (graftnum)" in md
+    assert "block_2" in md and "nonfinite grad leaves" in md
+
+
+def test_disarmed_nan_grad_still_gets_census_and_zero_num_residue(tmp_path, monkeypatch):
+    """The disarmed satellite: graftnum OFF, nonfinite_guard on (default),
+    incidents armed via the anomaly knob — a nan_grad trip still writes a
+    census-only numerics.json (no forward bisect, no latched taps), and the
+    run leaves ZERO num/* residue in metrics.jsonl."""
+    monkeypatch.setenv("TRLX_TPU_FAULTS", "nan_grad@2")
+    monkeypatch.delenv("TRLX_TPU_GRAFTNUM", raising=False)
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=60, seed=1000
+    )
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 3
+    config.train.epochs = 1
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(tmp_path)
+    config.train.anomaly_factor = 1000.0  # arms IncidentCapture only
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    assert model._graftnum is None and not obs_numerics.enabled()
+    assert model.skipped_steps >= 1
+
+    incidents_dir = os.path.join(str(tmp_path), "incidents")
+    payloads = []
+    for name in sorted(os.listdir(incidents_dir)):
+        p = os.path.join(incidents_dir, name, "numerics.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                payloads.append(json.load(f))
+    assert payloads, "disarmed guard trip lost its census"
+    payload = payloads[0]
+    assert payload["grad_census"]["total_nonfinite_leaves"] > 0
+    assert "forward_bisect" not in payload  # bisector is graftnum-armed only
+
+    with open(os.path.join(str(tmp_path), "metrics.jsonl")) as f:
+        assert not any('"num/' in line for line in f), "num/* residue while disarmed"

@@ -249,6 +249,15 @@ class TrainConfig:
     # guarded allgathers per resync; the drift bound between resyncs is part
     # of the trace's stated alignment error). 0 = startup-only estimate.
     fleet_resync_interval: int = 0
+    # graftnum (trlx_tpu/observability/numerics.py): streaming numerics
+    # observatory — per-subtree grad/param-norm + update-ratio reductions
+    # compiled into the train step (num/* gauges), NaN provenance on guard
+    # trips (non-finite grad census + first-NaN layer bisection into the
+    # incident bundle's numerics.json), int8 quantization-error gauges at
+    # each weight-version handoff, and the grad-spike / update-ratio health
+    # detectors. Disarmed hooks are one dict load — the serial path stays
+    # byte-identical. TRLX_TPU_GRAFTNUM=1 overrides.
+    graftnum: bool = False
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -44,6 +44,7 @@ import numpy as np
 
 from trlx_tpu.models.lm import init_cache
 from trlx_tpu.observability import graftscope
+from trlx_tpu.observability import numerics as obs_numerics
 from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.observability.spans import trace_span
 from trlx_tpu.ops.sampling import GenerateConfig, process_logits_default
@@ -201,6 +202,12 @@ class RolloutEngine:
         sanitize.race_access(self, "slot_state", write=True)
         self._variables = variables
         self.weight_version = version
+        if obs_numerics.enabled():
+            # graftnum quant-error probe at the handoff boundary: eager
+            # round-trip over the handed-off params (+ an embedding-derived
+            # KV proxy) — refreshes the num/quant_err_* gauges per version,
+            # never touches the compiled decode programs.
+            obs_numerics.record_weight_handoff(variables, version=version)
 
     def submit(self, input_ids, attention_mask) -> int:
         """Queue left-padded prompts ([n, width] or [width]) for decode."""

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trlx_tpu.observability import numerics as obs_numerics
+
 Dtype = Any
 
 
@@ -292,13 +294,18 @@ class HeadParams(nn.Module):
 QUANT_KERNEL_NAMES = ("c_qkv", "q_proj", "k_proj", "v_proj", "c_proj", "c_fc", "lm_head")
 
 
-def quantize_weights(params):
+def quantize_weights(params, probe=None):
     """Build the ``qw`` variable collection: per-output-channel symmetric
     int8 of every trunk matmul kernel (+ untied lm_head), mirroring module
     paths so QDense finds its own leaves. Jit this (it is a cheap tree_map —
     ~10 ms at 2B) and rebuild whenever the policy params change (the trainer
     re-quantizes before each rollout phase). Embeddings, layernorms, and the
-    RL heads stay full precision."""
+    RL heads stay full precision.
+
+    ``probe`` (graftnum error probe, observability/numerics.py): a dict that
+    accumulates per-kernel-class ``[max_abs_err, sum_sq_err, sum_sq_signal,
+    count]`` from the int8 round trip. Callers on the hot path pass nothing
+    — the default-None argument keeps the jitted trace identical."""
 
     def walk(node):
         out = {}
@@ -312,6 +319,13 @@ def quantize_weights(params):
                     "kernel_q": jnp.round(w / scale).astype(jnp.int8),
                     "scale": scale,
                 }
+                if probe is not None:
+                    err = w - out[k]["kernel_q"].astype(jnp.float32) * scale
+                    slot = probe.setdefault(k, [jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), 0])
+                    slot[0] = jnp.maximum(slot[0], jnp.max(jnp.abs(err)))
+                    slot[1] = slot[1] + jnp.sum(err * err)
+                    slot[2] = slot[2] + jnp.sum(w * w)
+                    slot[3] = slot[3] + int(w.size)
             else:
                 sub = walk(v)
                 if sub:
@@ -702,6 +716,12 @@ class TransformerLM(nn.Module):
                 cfg.max_position, cfg.d_model, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="wpe"
             )(position_ids)
             x = x + wpe
+        if start_layer == 0:
+            # graftnum probe tap (observability/numerics.py): identity unless
+            # the NaN-provenance bisector's EAGER re-forward is live — inside
+            # a trace (the permanent hot-path state) this is one global load
+            # returning x, so the compiled program is tap-free.
+            x = obs_numerics.probe_tap("embed", x)
 
         use_ring = ring_eligible(cfg, q_len, cache is not None, b)
         # Prefill at a STATIC zero write offset may use flash over the local
@@ -764,10 +784,12 @@ class TransformerLM(nn.Module):
                 x, layer_bias, position_ids, layer_cache, cache_index,
                 flash_mask, layer_window, use_ring,
             )
+            x = obs_numerics.probe_tap(f"block_{i}", x)
             if cache is not None:
                 new_cache.append(layer_new_cache)
 
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name="ln_f")(x)
+        x = obs_numerics.probe_tap("ln_f", x)
         if collect_hidden_at is not None and collect_hidden_at == cfg.n_layer:
             branch_hidden = x
 
@@ -839,11 +861,25 @@ class TransformerLM(nn.Module):
         }
 
 
-def quantize_kv(x: jnp.ndarray):
-    """[b, t, h, d] → (int8 values, [b, t, h] fp32 absmax scales)."""
+def quantize_kv(x: jnp.ndarray, probe=None, probe_class: str = "kv"):
+    """[b, t, h, d] → (int8 values, [b, t, h] fp32 absmax scales).
+
+    ``probe`` (graftnum error probe): accumulates the int8 round-trip error
+    under ``probe_class`` in the same ``[max_abs_err, sum_sq_err,
+    sum_sq_signal, count]`` layout as ``quantize_weights``. The decode hot
+    path passes nothing — default-None keeps the traced program identical."""
     xf = x.astype(jnp.float32)
     scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0 + 1e-12
     q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    if probe is not None:
+        err = xf - q.astype(jnp.float32) * scale[..., None]
+        slot = probe.setdefault(
+            probe_class, [jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), 0]
+        )
+        slot[0] = jnp.maximum(slot[0], jnp.max(jnp.abs(err)))
+        slot[1] = slot[1] + jnp.sum(err * err)
+        slot[2] = slot[2] + jnp.sum(xf * xf)
+        slot[3] = slot[3] + int(xf.size)
     return q, scale
 
 

@@ -1,8 +1,9 @@
 """Unified observability layer (PR 8) + training-health monitor (PR 9)
 + graftscope attribution ledger & run forensics (PR 12)
-+ graftfleet cross-host federation (PR 14).
++ graftfleet cross-host federation (PR 14)
++ graftnum streaming numerics observatory (PR 15).
 
-Eight parts, all off-hot-path and off by default:
+Nine parts, all off-hot-path and off by default:
 
 - ``spans``     — cross-thread Chrome-trace span tracing into
                   ``<ckpt_dir>/spans.jsonl`` (``train.trace_spans`` /
@@ -35,17 +36,25 @@ Eight parts, all off-hot-path and off by default:
                   per-collective straggler attribution from guarded-
                   collective arrival records, fleet health rollup on
                   ``/healthz``, and cross-host incident bundles
-                  (``train.graftfleet`` / ``TRLX_TPU_GRAFTFLEET=1``).
+                  (``train.graftfleet`` / ``TRLX_TPU_GRAFTFLEET=1``);
+- ``numerics``  — graftnum streaming numerics observatory: per-subtree
+                  grad/update-ratio telemetry folded into the jitted step
+                  at build time (``num/*`` gauges), NaN provenance (leaf
+                  census + first-NaN layer bisect) attached to guard-skip
+                  incident bundles, quantization-error tracking at weight
+                  handoffs, and grad-spike / update-ratio health detectors
+                  (``train.graftnum`` / ``TRLX_TPU_GRAFTNUM=1``).
 
 See RUNBOOK.md §8 (performance), §9 (training health), §12 (device-time
-attribution & run forensics) and §14 (fleet observability) for knobs and
-triage.
+attribution & run forensics), §14 (fleet observability) and §15 (numerics
+observability) for knobs and triage.
 """
 
 import os
 
 from trlx_tpu.observability import fleet  # noqa: F401 — canonical import point
 from trlx_tpu.observability import graftscope  # noqa: F401 — canonical import point
+from trlx_tpu.observability import numerics  # noqa: F401 — canonical import point
 from trlx_tpu.observability import spans  # noqa: F401 — canonical import point
 from trlx_tpu.observability.anomaly import AnomalyDetector, IncidentCapture  # noqa: F401
 from trlx_tpu.observability.devicemon import DeviceMonitor  # noqa: F401

@@ -338,6 +338,119 @@ def _fleet_section(checkpoint_dir):
     return lines
 
 
+def _numerics_section(checkpoint_dir, scalars):
+    """Render graftnum's numerics artifacts: the global grad-norm trend,
+    the per-subtree grad/update-ratio table, quantization-error gauges per
+    kernel class, and the NaN-provenance verdict of every incident bundle
+    that carries a numerics.json."""
+    from trlx_tpu.observability import numerics as obs_numerics
+
+    lines = ["## Numerics (graftnum)", ""]
+    num_keys = sorted({k for r in scalars for k in r if k.startswith("num/")})
+    incidents_dir = os.path.join(checkpoint_dir, "incidents")
+    numerics_bundles = []
+    if os.path.isdir(incidents_dir):
+        for name in sorted(os.listdir(incidents_dir)):
+            path = os.path.join(incidents_dir, name, obs_numerics.NUMERICS_FILENAME)
+            try:
+                with open(path) as f:
+                    numerics_bundles.append((name, json.load(f)))
+            except (OSError, ValueError):
+                continue
+    if not num_keys and not numerics_bundles:
+        lines.append("No numerics records (train.graftnum off — set it or TRLX_TPU_GRAFTNUM=1).")
+        lines.append("")
+        return lines
+    gnorm = [float(r["num/grad_global_norm"]) for r in scalars if "num/grad_global_norm" in r]
+    # NaN records are real data here (the guard-tripped step logs a NaN
+    # norm) but poison min/max and the sparkline — count them, trend the rest.
+    gnorm_bad = sum(1 for v in gnorm if not np.isfinite(v))
+    gnorm_ok = [v for v in gnorm if np.isfinite(v)]
+    if gnorm:
+        line = f"- global grad norm: {len(gnorm)} records"
+        if gnorm_ok:
+            line += (
+                f" · last finite {_fmt(gnorm_ok[-1])} · max {_fmt(max(gnorm_ok))}"
+                f" · trend `{_trend(gnorm_ok)}`"
+            )
+        if gnorm_bad:
+            line += f" · {gnorm_bad} NONFINITE record(s)"
+        lines.append(line)
+        lines.append("")
+    subtrees = sorted(
+        {k[len("num/grad_norm/"):] for k in num_keys if k.startswith("num/grad_norm/")}
+    )
+    if subtrees:
+        lines.append("| subtree | grad_norm (last) | param_norm (last) | update_ratio (last) | ratio trend |")
+        lines.append("|---|---|---|---|---|")
+        for sub in subtrees:
+            ratios = [
+                float(r[f"num/update_ratio/{sub}"])
+                for r in scalars
+                if f"num/update_ratio/{sub}" in r
+                and np.isfinite(float(r[f"num/update_ratio/{sub}"]))
+            ]
+            last = {
+                col: next(
+                    (r[f"num/{col}/{sub}"] for r in reversed(scalars) if f"num/{col}/{sub}" in r),
+                    None,
+                )
+                for col in ("grad_norm", "param_norm", "update_ratio")
+            }
+            lines.append(
+                f"| {sub} | {_fmt(last['grad_norm'], 4)} | {_fmt(last['param_norm'], 2)} "
+                f"| {_fmt(last['update_ratio'], 6)} | `{_trend(ratios)}` |"
+            )
+        lines.append("")
+    classes = sorted(
+        {k[len("num/quant_err_rms/"):] for k in num_keys if k.startswith("num/quant_err_rms/")}
+    )
+    if classes:
+        version = next(
+            (r["num/quant_weight_version"] for r in reversed(scalars) if "num/quant_weight_version" in r),
+            None,
+        )
+        lines.append(
+            f"### Quantization error (last handoff, weight version {_fmt(version, 0)})"
+        )
+        lines.append("")
+        lines.append("| kernel class | max_abs_err | rms_err | snr_db |")
+        lines.append("|---|---|---|---|")
+        for cls in classes:
+            row = {
+                col: next(
+                    (r[f"num/{col}/{cls}"] for r in reversed(scalars) if f"num/{col}/{cls}" in r),
+                    None,
+                )
+                for col in ("quant_err_max", "quant_err_rms", "quant_snr_db")
+            }
+            lines.append(
+                f"| {cls} | {_fmt(row['quant_err_max'], 6)} "
+                f"| {_fmt(row['quant_err_rms'], 6)} | {_fmt(row['quant_snr_db'], 1)} |"
+            )
+        lines.append("")
+    if numerics_bundles:
+        lines.append("### NaN provenance")
+        lines.append("")
+        for name, payload in numerics_bundles:
+            census = payload.get("grad_census", {}) or {}
+            bisect = payload.get("forward_bisect", {}) or {}
+            leaves = census.get("nonfinite_leaves", []) or []
+            first = bisect.get("first_nonfinite")
+            verdict = f"first nonfinite at `{first}`" if first else "forward clean"
+            if bisect.get("injected"):
+                verdict += f" (drill injection: {bisect['injected']})"
+            head = " · ".join(leaf.get("path", "?") for leaf in leaves[:3])
+            lines.append(
+                f"- `incidents/{name}/numerics.json`: "
+                f"{census.get('total_nonfinite_leaves', 0)} nonfinite grad leaves"
+                + (f" ({head}{' …' if len(leaves) > 3 else ''})" if leaves else "")
+                + f" · {verdict}"
+            )
+        lines.append("")
+    return lines
+
+
 # ----------------------------------------------------------------- report
 
 
@@ -491,6 +604,9 @@ def build_report(checkpoint_dir: str) -> str:
 
     # --- graftfleet: cross-host federation --------------------------------
     lines += _fleet_section(checkpoint_dir)
+
+    # --- graftnum: numerics observatory -----------------------------------
+    lines += _numerics_section(checkpoint_dir, scalars)
 
     # --- training health --------------------------------------------------
     incidents_dir = os.path.join(checkpoint_dir, "incidents")

@@ -37,7 +37,18 @@ What "tick" means is defined by the injection site:
                        the health monitor OBSERVES is scaled by
                        ``TRLX_TPU_ENTROPY_COLLAPSE_SCALE`` (default 0.01) →
                        walks the entropy-collapse detector's path, same
-                       stats-only contract.
+                       stats-only contract;
+- ``nan_layer@N``    — step N's batch is NaN-poisoned like ``nan_grad``
+                       (the non-finite guard genuinely trips) AND the
+                       graftnum probe tap ``block_<min(N, n_layer-1)>`` is
+                       latched as the NaN-provenance bisector's injection
+                       target (trlx_tpu/observability/numerics.py) — the
+                       instrumented re-forward in the incident bundle's
+                       ``numerics.json`` must name exactly that layer as
+                       first-NaN. Training sees only the batch poison; the
+                       tap injection lives in the EAGER bisector forward
+                       (same stats-only/injection contract as
+                       ``reward_drift`` / ``entropy_collapse``).
 
 Multi-host kinds (fired per PROCESS — a 2-process drill sets a different
 ``TRLX_TPU_FAULTS`` on each worker; tests/test_distributed_resilience.py):
@@ -74,6 +85,7 @@ KINDS = (
     "slow_step",
     "reward_drift",
     "entropy_collapse",
+    "nan_layer",
     "host_hang",
     "host_kill",
     "slow_host",

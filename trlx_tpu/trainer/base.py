@@ -40,6 +40,7 @@ from trlx_tpu.models.heads import trainable_mask
 from trlx_tpu import observability as obs
 from trlx_tpu.observability import fleet as obs_fleet
 from trlx_tpu.observability import graftscope as obs_graftscope
+from trlx_tpu.observability import numerics as obs_numerics
 from trlx_tpu.observability import spans as obs_spans
 from trlx_tpu.parallel import make_mesh, set_mesh, shard_pytree
 from trlx_tpu.parallel.mesh import DATA_AXES, barrier, init_distributed, is_main_process
@@ -210,6 +211,10 @@ class JaxBaseTrainer(BaseRLTrainer):
         self._rollbacks = 0
         self.skipped_steps = 0  # total guard-skipped updates (host count)
         self._res_pending = []  # buffered per-step device scalars (no sync)
+        # Parallel host-side batch refs for the graftnum nonfinite census:
+        # populated ONLY when incident capture is armed (None placeholders
+        # otherwise), so default runs keep zero extra references alive.
+        self._res_batch_refs = []
         self.last_restore_fallback = False  # load() fell past latest.txt
         self.watchdog = (
             DivergenceWatchdog(
@@ -342,6 +347,28 @@ class JaxBaseTrainer(BaseRLTrainer):
                 self._health.register_detector(self._fleet.straggler)
         else:
             obs_fleet.shutdown()
+        # graftnum (streaming numerics observatory, trlx_tpu/observability/
+        # numerics.py): per-subtree grad/update telemetry folded into the
+        # jitted step at BUILD time, NaN-provenance census + bisect on guard
+        # trips, and quantization-error gauges at weight handoffs. Arming it
+        # implies IncidentCapture (the provenance artifact lives in the
+        # guard_skip bundle). Construction-owned like the span tracer.
+        self._graftnum = None
+        if obs_numerics.armed(config.train):
+            self._graftnum = obs_numerics.configure()
+            if self._incidents is None:
+                self._incidents = self._build_incident_capture(ckpt_dir)
+            if self._health is not None:
+                for det in self._graftnum.detectors:
+                    self._health.register_detector(det)
+            else:
+                # No health monitor: CRIT transitions still escalate through
+                # the shared emergency-capture hook (same health_<name>
+                # incident reason, so the report cross-links either way).
+                for det in self._graftnum.detectors:
+                    det.on_crit = obs_numerics.escalate
+        else:
+            obs_numerics.shutdown()
         # Live /metrics + /healthz endpoint (trlx_tpu/observability/
         # export.py): process 0 only, armed by the port knob. The port is
         # recorded on EVERY process — multi-host gauge rollup needs all
@@ -1022,6 +1049,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                 # report's Fleet section.
                 obs_fleet.shutdown()
                 self._fleet = None
+            if self._graftnum is not None:
+                # No thread to join — clears the process-global instance and
+                # any latched bisector injection so a later trainer in this
+                # process starts clean.
+                obs_numerics.shutdown()
+                self._graftnum = None
             if self._metrics_exporter is not None:
                 # Exporter last: it only serves snapshots, so scrapers get
                 # the final gauge state right up to teardown.
@@ -1133,6 +1166,18 @@ class JaxBaseTrainer(BaseRLTrainer):
                         # leaves of THIS step's batch (fault drill for the
                         # on-device non-finite guard).
                         step_batch = poison_nan(device_batch)
+                    if self.fault_plan and self.fault_plan.fire(
+                        "nan_layer", self.iter_count + 1
+                    ):
+                        # NaN-provenance drill: same batch poison (the guard
+                        # genuinely trips) PLUS a latched tap injection so the
+                        # graftnum bisector's re-forward must name that layer
+                        # as first-NaN. One @N gives both the step tick and
+                        # the target block (clamped to the model's depth).
+                        step_batch = poison_nan(device_batch)
+                        n_layer = int(self.model.cfg.n_layer)
+                        tap = f"block_{min(self.iter_count + 1, n_layer - 1)}"
+                        obs_numerics.latch_injection(tap)
                     with self._dispatch_lock:
                         prev_state = self.state
                         self.state, stats = self.train_step(self.state, step_batch)
@@ -1168,6 +1213,14 @@ class JaxBaseTrainer(BaseRLTrainer):
                                 stats.get("resilience/nonfinite"),
                                 stats.get("resilience/bad_steps"),
                             )
+                        )
+                        # Batch ref for the guard-skip census (popped in
+                        # lockstep by _flush_resilience). Kept ONLY when a
+                        # trip could produce an incident bundle — None
+                        # placeholders otherwise, so default runs pin no
+                        # extra device memory.
+                        self._res_batch_refs.append(
+                            step_batch if self._incidents is not None else None
                         )
                         if len(self._res_pending) >= max(self.config.train.log_interval, 8):
                             self._flush_resilience()
@@ -1262,6 +1315,12 @@ class JaxBaseTrainer(BaseRLTrainer):
                                 v = np.asarray(v)
                                 stats_host[f"{k}/mean"] = float(v.mean())
                                 stats_host[f"{k}/max"] = float(v.max())
+                        if self._graftnum is not None:
+                            # Numerics feed BEFORE the health gauges merge:
+                            # the grad-spike / update-ratio detectors judge
+                            # this record's num/* scalars, so their
+                            # health/*_state gauges below reflect THIS step.
+                            self._graftnum.observe_train(stats_host)
                         if self._health is not None:
                             # Health feed: judge the synced per-step stats,
                             # then ride the health/* gauges along in the same
@@ -1284,6 +1343,15 @@ class JaxBaseTrainer(BaseRLTrainer):
                             stats_host.update(self._health.gauges())
                             self._health.maybe_log_lineage(
                                 self.tracker, self.iter_count
+                            )
+                        if self._graftnum is not None:
+                            # Quant-error gauges from the latest weight
+                            # handoff; detector states ride along only when
+                            # no health monitor already emits them.
+                            stats_host.update(
+                                self._graftnum.gauges(
+                                    include_states=self._health is None
+                                )
                             )
                         self._export_metrics(stats_host)
                         if self._fleet is not None:
@@ -1403,11 +1471,21 @@ class JaxBaseTrainer(BaseRLTrainer):
         if not self._res_pending:
             return
         pending, self._res_pending = self._res_pending, []
+        batch_refs, self._res_batch_refs = self._res_batch_refs, []
+        if len(batch_refs) < len(pending):
+            # Refs are best-effort (a subclass step that bypasses the learn
+            # loop appends none) — pad rather than misalign the zip.
+            batch_refs = batch_refs + [None] * (len(pending) - len(batch_refs))
         max_bad = self.config.train.max_bad_steps
         skips_before = self.skipped_steps
-        for loss, nonfinite, bad in jax.device_get(pending):
+        offending_batch = None
+        for (loss, nonfinite, bad), batch in zip(jax.device_get(pending), batch_refs):
             if nonfinite is not None and float(nonfinite) > 0:
                 self.skipped_steps += 1
+                if offending_batch is None:
+                    # First tripped step in the window: the batch the NaN
+                    # census re-derives gradients from.
+                    offending_batch = batch
             if bad is not None and max_bad > 0 and int(bad) >= max_bad:
                 raise TrainingDiverged(
                     f"{int(bad)} consecutive non-finite train steps (>= "
@@ -1432,16 +1510,51 @@ class JaxBaseTrainer(BaseRLTrainer):
             )
             incidents = getattr(self, "_incidents", None)
             if incidents is not None:
-                incidents.capture(
+                bundle_dir = incidents.capture(
                     self.iter_count,
                     "guard_skip",
                     detail={"skipped_steps": int(self.skipped_steps)},
                 )
+                if bundle_dir and offending_batch is not None:
+                    self._capture_numerics(bundle_dir, offending_batch)
             if getattr(self, "tracker", None) is not None:
                 self.tracker.log(
                     {"resilience/skipped_steps": float(self.skipped_steps)},
                     step=self.iter_count,
                 )
+
+    def _capture_numerics(self, bundle_dir: str, batch):
+        """NaN-provenance artifact for a guard-skip incident bundle
+        (trlx_tpu/observability/numerics.py). Two parts, both incident-path
+        only — the hot step is never touched:
+
+        - grad census: the jitted step donated its gradient tree, so
+          re-derive it EAGERLY from the stored loss_fn on the offending
+          microbatch and name every nonfinite leaf by param path. Runs
+          whenever the trainer exposes ``_numerics_loss_fn`` — i.e. even
+          with graftnum disarmed, a nonfinite_guard trip still gets leaf
+          provenance in its bundle.
+        - forward bisect (graftnum armed only): re-run the forward with the
+          probe taps live and record the FIRST layer producing NaN/Inf —
+          consuming any fault-drill injection latched by ``nan_layer@N``."""
+        payload = {"step": int(self.iter_count), "reason": "guard_skip"}
+        loss_fn = getattr(self, "_numerics_loss_fn", None)
+        if loss_fn is not None:
+            try:
+                with self._dispatch_lock:
+                    grads = jax.grad(lambda p: loss_fn(p, batch)[0])(
+                        self.state.params
+                    )
+                payload["grad_census"] = obs_numerics.nonfinite_census(grads)
+            except Exception as e:  # incident path must never kill training
+                payload["grad_census"] = {"error": repr(e)}
+        if obs_numerics.enabled() and hasattr(self, "_numerics_forward"):
+            with self._dispatch_lock:
+                payload["forward_bisect"] = obs_numerics.bisect_forward(
+                    lambda: self._numerics_forward(batch),
+                    inject=obs_numerics.consume_injection(),
+                )
+        obs_numerics.write_incident(bundle_dir, payload)
 
     def _fire_host_faults(self):
         """Per-PROCESS fault drills (trlx_tpu/resilience/faults.py): each
@@ -1554,6 +1667,7 @@ class JaxBaseTrainer(BaseRLTrainer):
             self._rebuild_for_lr_scale()
         self.watchdog.reset()
         self._res_pending = []
+        self._res_batch_refs = []
         self.iter_count = int(jax.device_get(self.state.step))
         if getattr(self, "tracker", None) is not None:
             self.tracker.log(

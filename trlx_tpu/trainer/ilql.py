@@ -22,6 +22,7 @@ import optax
 from trlx_tpu.data import ILQLBatch
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models.heads import LMWithILQLHeads
+from trlx_tpu.observability import numerics as obs_numerics
 from trlx_tpu.ops.fused_logprob import fused_logprob_eligible, routed_logprob
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.ilql_loss import action_tokens, ilql_loss, ilql_loss_terms
@@ -292,6 +293,16 @@ class ILQLTrainer(JaxBaseTrainer):
             )
 
         loss_fn = fused_loss_fn if use_fused else dense_loss_fn
+        # Incident-path handle for the graftnum NaN census: the same loss,
+        # reachable eagerly (the jitted step donates its inputs). Closes over
+        # the LIVE extras at call time, matching what the step just consumed.
+        self._numerics_loss_fn = lambda params, batch: loss_fn(
+            params, self.state.extras, batch
+        )
+        # Arming is resolved when the step is BUILT: a disarmed trainer
+        # compiles a jaxpr with no numerics reductions, so the serial path
+        # stays byte-identical (same contract as spans/graftscope).
+        graftnum = obs_numerics.armed(self.config.train)
 
         def train_step(state, batch: ILQLBatch):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, state.extras, batch)
@@ -313,12 +324,28 @@ class ILQLTrainer(JaxBaseTrainer):
             if self.config.train.watch_interval:
                 for group, sub in grads.items():
                     stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
+            if graftnum:
+                stats.update(
+                    obs_numerics.train_step_stats(grads, state.params, params)
+                )
             stats["learning_rate"] = schedule(state.step)
             return state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state, bad_steps=bad
             ), stats
 
         return jax.jit(train_step, donate_argnums=(0,))
+
+    def _numerics_forward(self, batch):
+        """Eval-only EAGER forward for the graftnum first-NaN bisector —
+        eager so the probe taps in models/lm.py see concrete activations.
+        Outputs are discarded; only per-layer finite-ness matters."""
+        self.model.apply(
+            {"params": self.state.params},
+            batch.input_ids,
+            batch.attention_mask,
+            states_ixs=batch.states_ixs,
+            actions_ixs=batch.actions_ixs,
+        )
 
     # ------------------------------------------------------------- callbacks
 

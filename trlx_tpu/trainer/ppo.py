@@ -22,6 +22,7 @@ from trlx_tpu.ops.fused_logprob import fused_logprob_eligible
 from trlx_tpu.ops.generate import make_generate_fn
 from trlx_tpu.ops.modeling import logprobs_from_logits
 from trlx_tpu.ops.rl_losses import kl_penalty_rewards, ppo_loss
+from trlx_tpu.observability import numerics as obs_numerics
 from trlx_tpu.ops.sampling import GenerateConfig
 from trlx_tpu.parallel.mesh import DATA_AXES
 from trlx_tpu.pipeline.overlap import PhaseTimer, RolloutProducer
@@ -448,6 +449,8 @@ class PPOTrainer(JaxBaseTrainer):
             }
             if self._qw is not None:
                 snap["qw"] = self._quantize_fn(snap["params"])
+            if obs_numerics.enabled():
+                obs_numerics.record_weight_quant(snap["params"], version=snap["version"])
             return snap
 
     def _decode_variables(self, snapshot=None):
@@ -500,6 +503,10 @@ class PPOTrainer(JaxBaseTrainer):
         if self._qw is not None:
             with self._dispatch_lock:
                 self._qw = self._quantize_fn(self.state.params)
+            if obs_numerics.enabled():
+                obs_numerics.record_weight_quant(
+                    self.state.params, version=int(self.iter_count)
+                )
 
     def _batch_prompt_length(self, tokens) -> int:
         """The prompt width of a rollout batch: total width minus the (fixed)
@@ -677,6 +684,12 @@ class PPOTrainer(JaxBaseTrainer):
     # ------------------------------------------------------------ train step
 
     def build_train_step(self):
+        # The same loss the jitted step compiles in, reachable OUTSIDE the
+        # donated program: the graftnum NaN census re-derives the gradient
+        # tree from it on the incident path (base._capture_numerics).
+        self._numerics_loss_fn = make_ppo_loss_fn(
+            self.model, self.config, self.prompt_length, self.detach_frozen
+        )
         return make_ppo_train_step(
             self.model,
             self.optimizer,
@@ -684,6 +697,30 @@ class PPOTrainer(JaxBaseTrainer):
             self.prompt_length,
             self.schedule,
             self.detach_frozen,
+        )
+
+    def _numerics_forward(self, batch):
+        """Eval-only EAGER forward over the offending microbatch for the
+        graftnum first-NaN bisector — eager so the probe taps in
+        models/lm.py actually observe concrete activations (a jitted call
+        would trace straight through them). Outputs are discarded; only
+        the taps' per-layer finite-ness matters."""
+        if isinstance(batch, PackedPPOBatch):
+            self.model.apply(
+                {"params": self.state.params},
+                batch.input_ids,
+                batch.attention_mask,
+                position_ids=batch.position_ids,
+                segment_ids=batch.segment_ids,
+            )
+            return
+        all_ids = jnp.concatenate([batch.query_tensors, batch.response_tensors], axis=1)
+        all_mask = jnp.concatenate([batch.query_mask, batch.response_mask], axis=1)
+        self.model.apply(
+            {"params": self.state.params},
+            all_ids,
+            all_mask,
+            logits_start=self.prompt_length - 1,
         )
 
     def load_host_state(self, d: dict):
@@ -891,14 +928,13 @@ class PPOTrainer(JaxBaseTrainer):
             engine.shutdown()
 
 
-def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detach_frozen):
-    """The jitted PPO update program, built from its explicit ingredients.
-
-    Factored out of PPOTrainer.build_train_step so AOT validation
-    (tests/test_scale_compile.py) can lower + compile the REAL production
-    step at 6B shapes from abstract arrays — without ever allocating the
-    parameters. The trainer method delegates here; there is exactly one
-    definition of the PPO update."""
+def make_ppo_loss_fn(model, config, prompt_length, detach_frozen):
+    """The PPO loss as a standalone ``loss_fn(params, batch) -> (loss,
+    stats)`` — the single ingredient both the jitted train step and the
+    graftnum incident path share: when the non-finite guard trips, the
+    gradient tree was consumed inside the donated step, so the NaN census
+    re-derives it from THIS function on the offending microbatch (eager,
+    no donation — incident path only, never the hot loop)."""
     m = config.method
     P = prompt_length
     use_fused = resolve_fused_head(model.cfg)
@@ -963,11 +999,24 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
         )
 
     if packed:
-        loss_fn = packed_loss_fn
-    elif use_fused:
-        loss_fn = fused_loss_fn
-    else:
-        loss_fn = dense_loss_fn
+        return packed_loss_fn
+    if use_fused:
+        return fused_loss_fn
+    return dense_loss_fn
+
+
+def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detach_frozen):
+    """The jitted PPO update program, built from its explicit ingredients.
+
+    Factored out of PPOTrainer.build_train_step so AOT validation
+    (tests/test_scale_compile.py) can lower + compile the REAL production
+    step at 6B shapes from abstract arrays — without ever allocating the
+    parameters. The trainer method delegates here; there is exactly one
+    definition of the PPO update."""
+    loss_fn = make_ppo_loss_fn(model, config, prompt_length, detach_frozen)
+    # graftnum gate, resolved at BUILD time: a disarmed program compiles to
+    # the identical pre-graftnum jaxpr (byte-identical loss contract).
+    graftnum = obs_numerics.armed(config.train)
 
     def train_step(state, batch: PPORLBatch):
         (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params, batch)
@@ -994,6 +1043,11 @@ def make_ppo_train_step(model, optimizer, config, prompt_length, schedule, detac
             # scalars, fetched only at log boundaries with the rest
             for group, sub in grads.items():
                 stats[f"watch/grad_norm/{group}"] = optax.global_norm(sub)
+        if graftnum:
+            # graftnum per-subtree reductions (device scalars, fetched only
+            # at log boundaries): grad/param norms + the REALIZED update
+            # ratio — zero on guard-skipped steps, which is itself signal.
+            stats.update(obs_numerics.train_step_stats(grads, state.params, params))
         stats["learning_rate"] = schedule(state.step)
         new_state = state.replace(
             step=state.step + 1, params=params, opt_state=opt_state, bad_steps=bad
