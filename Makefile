@@ -35,23 +35,30 @@ test-shard3:
 
 # 2-process distributed drills: boundary-helper/train-resume semantics plus
 # the fault drills (host_hang → CollectiveTimeout, coordinated preemption
-# save/resume, host_desync → fingerprint guard). Non-blocking CI job —
+# save/resume, host_desync → fingerprint guard), and the disaggregated
+# rollout/learner fleet drills (rollout_host_kill → degraded drain,
+# broadcast_timeout → starved-worker abort, episode_stream_stall → STALLED
+# triage, 2-process staleness-0 parity; RUNBOOK §16). Non-blocking CI job —
 # jax.distributed on shared runners can be flaky; see RUNBOOK §3b for the
 # local drill command and the triage table.
 test-multihost:
 	$(TEST_ENV) python -m pytest -q -m slow \
 	    tests/test_multihost.py tests/test_distributed_resilience.py \
-	    tests/test_fleet_drill.py
+	    tests/test_fleet_drill.py tests/test_fleet_disagg.py
 
-# 2-process graftfleet drills under the full runtime sanitizer set: the
+# 2-process fleet drills under the full runtime sanitizer set: graftfleet's
 # slow_host drill (merged clock-aligned trace, skew table naming the
-# laggard, live fleet gauges) and the hang drill (cross-host incident
-# bundle). Set TRLX_TPU_DRILL_ARTIFACTS=<dir> to keep the merged trace +
-# report section (the CI job uploads them). Non-blocking CI job — same
-# jax.distributed caveats as test-multihost; RUNBOOK §14 has the triage.
+# laggard, live fleet gauges) and hang drill (cross-host incident bundle),
+# plus the disaggregated rollout/learner drills (host kill + preemption +
+# resume, broadcast timeout, stream stall, 2-process parity; RUNBOOK §16).
+# Set TRLX_TPU_DRILL_ARTIFACTS=<dir> to keep the merged trace, report
+# section, episode-stream index, broadcast log and fleet event log (the CI
+# job uploads them). Non-blocking CI job — jax.distributed caveats apply to
+# test_fleet_drill.py only (the disagg drills spawn independent
+# single-controller worlds); RUNBOOK §14/§16 have the triage.
 fleet-drill:
 	$(TEST_ENV) TRLX_TPU_SANITIZE=dispatch,donation,race python -m pytest -q \
-	    -m slow tests/test_fleet_drill.py
+	    -m slow tests/test_fleet_drill.py tests/test_fleet_disagg.py
 
 # graftlint + graftrace: AST invariant (GL001-GL007, RUNBOOK §11) and
 # concurrency (GL008-GL011, RUNBOOK §13) checks in one pass. Blocking,

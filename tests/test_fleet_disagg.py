@@ -1,0 +1,520 @@
+"""Disaggregated rollout/learner fleet (trlx_tpu/fleet): parity + drills.
+
+Fast tier (in-process): the acceptance identity — a COLOCATED fleet run at
+max_staleness=0 pushes every episode through the real transports (episode
+stream npz + versioned weight broadcast) yet produces the bitwise-identical
+loss trajectory to the serial schedule, re-proving the PR 5 contract
+THROUGH the stream rather than around it. Runs fully sanitized
+(dispatch/donation/race).
+
+Slow tier (2-process CPU drills): the robustness story. Unlike
+tests/test_fleet_drill.py these spawn NO jax.distributed world — the
+rollout job and the learner job are SEPARATE single-controller processes
+coupled only through train.fleet_dir, which is the whole point of the
+disaggregation (topology.py). Drills:
+
+- ``rollout_host_kill@N``: worker dies mid-phase → learner drains in-flight
+  episodes at elevated staleness, reports ``fleet/degraded`` on a LIVE
+  /healthz scrape, exits cleanly (no hang, no leaked trlx-* threads). The
+  same drill carries a coordinated-save preemption (sigterm on the learner)
+  plus a resume leg first — abort.json must NOT land on preemption, and the
+  surviving worker keeps serving the resumed learner.
+- ``broadcast_timeout@N``: the learner skips a publish → the staleness-0
+  worker starves under collective_guard and aborts with exit 117.
+- ``episode_stream_stall@N``: the worker stalls WITH a live heartbeat →
+  triage says STALLED (not dead).
+- 2-process staleness-0 parity: the distributed form of the acceptance
+  identity, learner losses bitwise equal to a serial run.
+
+When ``TRLX_TPU_DRILL_ARTIFACTS`` is set (the CI fleet-drill job does),
+each drill copies the episode-stream index, broadcast log, fleet event log
+and both role logs there for upload.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.fleet.topology import read_jsonl_or_empty  # noqa: E402
+from trlx_tpu.resilience.distributed import EXIT_COLLECTIVE_TIMEOUT  # noqa: E402
+
+SANITIZE = "dispatch,donation,race"
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+# ----------------------------------------------------- colocated parity (fast)
+
+
+def _run_ppo(task, ckpt_dir, fleet=False, **overrides):
+    _, logit_mask, metric_fn, reward_fn = task
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = 8
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(ckpt_dir)
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    if fleet:
+        config.method.fleet_disaggregate = True
+        config.train.fleet_dir = str(ckpt_dir) + "_fleet"
+    for k, v in overrides.items():
+        setattr(config.method, k, v)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    with open(os.path.join(str(ckpt_dir), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    return model, records
+
+
+def test_colocated_staleness0_matches_serial_bitwise(task, tmp_path, monkeypatch):
+    """Staleness-0 disaggregated (colocated both-roles-one-process) run:
+    every batch round-trips episodes/batch_*.npz and every weight hand-off
+    round-trips weights_*.npz, and the loss trajectory is still bitwise
+    equal to the serial path. Fully sanitized: the fleet snapshot path
+    dispatches under the lock, donation and race trackers armed."""
+    from trlx_tpu.utils import sanitize
+
+    _, serial = _run_ppo(task, tmp_path / "serial")
+
+    monkeypatch.setenv(sanitize.ENV_VAR, SANITIZE)
+    try:
+        model, fleet = _run_ppo(task, tmp_path / "colo", fleet=True, max_staleness=0)
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
+        sanitize.clear_races()
+
+    losses_serial = [r["loss"] for r in serial if "loss" in r]
+    losses_fleet = [r["loss"] for r in fleet if "loss" in r]
+    assert len(losses_serial) == 8
+    assert losses_fleet == losses_serial
+
+    # The run really went through the transports, all on-policy.
+    fleet_dir = str(tmp_path / "colo") + "_fleet"
+    stream = read_jsonl_or_empty(os.path.join(fleet_dir, "stream.jsonl"))
+    broadcast = read_jsonl_or_empty(os.path.join(fleet_dir, "broadcast.jsonl"))
+    assert len(stream) >= 2
+    published = {r["version"] for r in broadcast if r["status"] == "published"}
+    assert {r["weight_version"] for r in stream} <= published
+    stale = [r["staleness/mean"] for r in fleet if "staleness/mean" in r]
+    assert stale and all(s == 0.0 for s in stale)
+    # Clean teardown: feed shut down and detached, no leaked threads.
+    assert model._fleet_feed is None
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+    # Coordinated completion landed for any (absent) worker to observe.
+    with open(os.path.join(fleet_dir, "abort.json")) as f:
+        assert json.load(f)["reason"] == "complete"
+
+
+# ------------------------------------------------------- 2-process drills
+
+pytest_slow = pytest.mark.slow
+
+_ROLE_WORKER = r"""
+import json, os, sys, threading, time
+import urllib.request
+import numpy as np
+
+role = sys.argv[1]            # "serial" | "rollout" | "learner"
+ckpt = sys.argv[2]
+fleet_dir = sys.argv[3]
+S = int(sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["TRLX_TPU_NO_PROGRESS"] = "1"
+
+sys.path.insert(0, os.path.join(os.environ["TRLX_REPO"], "examples"))
+import trlx_tpu
+from randomwalks import base_config, generate_random_walks
+
+_, logit_mask, metric_fn, reward_fn = generate_random_walks(
+    n_nodes=15, max_length=8, n_walks=60, seed=1000
+)
+
+config = base_config("ppo", 15, 8)
+config.train.total_steps = int(os.environ.get("TOTAL", "8"))
+config.train.epochs = int(os.environ.get("EPOCHS", "4"))
+config.train.batch_size = 16
+config.train.eval_interval = 100
+config.train.checkpoint_dir = ckpt
+config.train.resume_from_checkpoint = bool(int(os.environ.get("RESUME", "0")))
+config.method.num_rollouts = 16
+config.method.chunk_size = 16
+if role != "serial":
+    config.method.fleet_disaggregate = True
+    config.method.max_staleness = S
+    config.train.fleet_dir = fleet_dir
+    # Drill-scale timing: seconds, not the production minutes.
+    config.train.heartbeat_interval = 0.2
+    config.train.fleet_episode_timeout = 2.0
+    config.train.fleet_stream_retries = 1
+    config.train.fleet_stream_backoff = 0.2
+    config.train.fleet_heartbeat_timeout = 3.0
+    config.train.fleet_broadcast_deadline = float(os.environ.get("BDEADLINE", "60"))
+
+scrapes_stop = threading.Event()
+
+def scrape_loop():
+    # Live witness for the degraded window: the drill must observe
+    # fleet/degraded on /healthz WHILE the learner drains, not post-hoc.
+    mport = int(os.environ.get("TRLX_TPU_METRICS_PORT", "0"))
+    while not scrapes_stop.is_set():
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/healthz", timeout=2
+            ) as r:
+                payload = json.loads(r.read().decode())
+            block = payload.get("fleet", {}).get("disaggregated")
+            if block:
+                with open(os.path.join(ckpt, "scrape_last.json"), "w") as f:
+                    json.dump(block, f)
+                if block.get("state") == "degraded":
+                    with open(os.path.join(ckpt, "scrape_degraded.json"), "w") as f:
+                        json.dump(payload, f)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=2
+            ) as r:
+                body = r.read().decode()
+            if "trlx_tpu_fleet_degraded 1" in body:
+                with open(os.path.join(ckpt, "scrape_metrics.txt"), "w") as f:
+                    f.write(body)
+        except Exception:
+            pass  # exporter not up yet / mid-teardown
+        scrapes_stop.wait(0.05)
+
+scraper = None
+if role == "learner" and os.environ.get("TRLX_TPU_METRICS_PORT"):
+    os.makedirs(ckpt, exist_ok=True)
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+
+prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+try:
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+finally:
+    scrapes_stop.set()
+    if scraper is not None:
+        scraper.join(timeout=5)
+
+if role in ("serial", "learner"):
+    with open(os.path.join(ckpt, "metrics.jsonl")) as f:
+        losses = [json.loads(l).get("loss") for l in f]
+    print("LOSSES", json.dumps([l for l in losses if l is not None]))
+print("THREADS", json.dumps([t.name for t in threading.enumerate() if t.name.startswith("trlx-")]))
+print(f"fleet role {role} DONE")
+"""
+
+
+def _script(tmp_path):
+    script = tmp_path / "fleet_role_worker.py"
+    script.write_text(_ROLE_WORKER)
+    return str(script)
+
+
+def _launch_role(tmp_path, role, ckpt, fleet_dir, staleness, extra_env=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("TRLX_TPU_FAULTS", None)
+    env.pop("TRLX_TPU_METRICS_PORT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env["TRLX_REPO"] = repo
+    env["TRLX_TPU_SANITIZE"] = SANITIZE
+    if role != "serial":
+        env["TRLX_TPU_FLEET_ROLE"] = role
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, _script(tmp_path), role, str(ckpt), str(fleet_dir), str(staleness)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _communicate(proc, timeout=900):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.skip("2-process fleet drill did not complete in this environment")
+    return out.decode(errors="replace")
+
+
+def _events(fleet_dir):
+    return read_jsonl_or_empty(os.path.join(str(fleet_dir), "fleet_events.jsonl"))
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _export_artifacts(fleet_dir, logs):
+    dest = os.environ.get("TRLX_TPU_DRILL_ARTIFACTS")
+    if not dest:
+        return
+    os.makedirs(dest, exist_ok=True)
+    for name in ("stream.jsonl", "broadcast.jsonl", "fleet_events.jsonl", "weights_latest.json", "abort.json"):
+        src = os.path.join(str(fleet_dir), name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(dest, name))
+    for name, text in logs.items():
+        with open(os.path.join(dest, name), "w") as f:
+            f.write(text)
+
+
+def _assert_clean_threads(out, who):
+    lines = [l for l in out.splitlines() if l.startswith("THREADS ")]
+    assert lines, f"{who} never reported its thread census:\n{out[-2000:]}"
+    assert json.loads(lines[-1][len("THREADS "):]) == [], f"{who} leaked threads: {lines[-1]}"
+
+
+@pytest.mark.slow
+def test_fleet_drill_rollout_host_kill_with_preemption_and_resume(tmp_path):
+    """The flagship drill, three legs against ONE persistent worker:
+
+    1. learner leg 1 is preempted (sigterm@12) at a save boundary →
+       exits 0, writes NO abort marker, worker keeps serving;
+    2. learner leg 2 resumes from the checkpoint, republishes its restored
+       version, and keeps consuming from the cursor;
+    3. the worker is killed mid-phase (rollout_host_kill@6) → leg 2 drains
+       the in-flight episodes at staleness ≤ cap, reports fleet/degraded on
+       a live /healthz scrape, triages the peer as DEAD, and exits cleanly.
+    """
+    fleet_dir = tmp_path / "fleet"
+    env = {"TOTAL": "100", "EPOCHS": "100"}
+    worker = _launch_role(
+        tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 2,
+        {**env, "TRLX_TPU_FAULTS": "rollout_host_kill@6"},
+    )
+    logs = {}
+    try:
+        mport = _free_port()
+        learner_env = {**env, "TRLX_TPU_METRICS_PORT": str(mport)}
+        leg1 = _launch_role(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 2,
+            {**learner_env, "TRLX_TPU_FAULTS": "sigterm@12"},
+        )
+        out1 = logs["learner_leg1.log"] = _communicate(leg1)
+        assert leg1.returncode == 0, f"preempted learner leg failed:\n{out1[-4000:]}"
+        # Preemption is NOT a shutdown: no abort marker, worker survives.
+        assert not os.path.exists(os.path.join(str(fleet_dir), "abort.json"))
+        assert worker.poll() is None, "worker died during learner preemption"
+        _assert_clean_threads(out1, "learner leg 1")
+
+        leg2 = _launch_role(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 2,
+            {**learner_env, "RESUME": "1"},
+        )
+        out2 = logs["learner_leg2.log"] = _communicate(leg2)
+        logs["worker.log"] = _communicate(worker, timeout=60)
+        assert leg2.returncode == 0, f"resumed learner leg failed:\n{out2[-4000:]}"
+        assert worker.returncode == 1  # rollout_host_kill is os._exit(1)
+        assert "[fleet] learner stopped cleanly" in out2
+        _assert_clean_threads(out2, "learner leg 2")
+
+        events = _events(fleet_dir)
+        exits = [e for e in events if e["event"] == "learner_exit"]
+        assert [e["reason"] for e in exits] == ["preempted", "degraded"]
+        degraded = [e for e in events if e["event"] == "degraded"]
+        assert degraded and degraded[0]["triage"] == "dead"
+        # The resumed leg restored its step and republished that version as
+        # a fresh DENSE ordinal before consuming anything (broadcast.py's
+        # resume contract: ordinals never fork, versions may repeat).
+        assert "resumed from step" in out2
+        starts = [i for i, e in enumerate(events) if e["event"] == "learner_start"]
+        assert len(starts) == 2
+        pre = [e["version"] for i, e in enumerate(events) if i < starts[1] and e["event"] == "weights_published"]
+        post = [e["version"] for i, e in enumerate(events) if i > starts[1] and e["event"] == "weights_published"]
+        assert pre and post and post[0] >= max(pre)
+        ordinals = [e["ordinal"] for e in events if e["event"] == "weights_published"]
+        assert ordinals == list(range(len(ordinals)))
+        # In-flight drain at elevated-but-capped staleness, hitting the cap.
+        consumed = [e for e in events if e["event"] == "episode_consumed"]
+        staleness = [e["staleness"] for e in consumed]
+        assert all(s <= 2 for s in staleness)
+        assert staleness[-1] == 2
+        assert [e["seq"] for e in consumed] == list(range(len(consumed)))
+
+        # Coordinated degraded shutdown marker (vs NO marker on preemption).
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            abort = json.load(f)
+        assert abort["reason"] == "degraded" and abort["triage"] == "dead"
+
+        # Every streamed episode's weight_version is a published version.
+        stream = read_jsonl_or_empty(os.path.join(str(fleet_dir), "stream.jsonl"))
+        broadcast = read_jsonl_or_empty(os.path.join(str(fleet_dir), "broadcast.jsonl"))
+        published = {r["version"] for r in broadcast if r["status"] == "published"}
+        assert stream and {r["weight_version"] for r in stream} <= published
+
+        # Live /healthz witness: fleet/degraded observed DURING the drain.
+        with open(os.path.join(str(tmp_path / "ckpt_l"), "scrape_degraded.json")) as f:
+            scrape = json.load(f)
+        block = scrape["fleet"]["disaggregated"]
+        assert block["state"] == "degraded"
+        assert block["triage"] == "dead"
+        assert block["role"] == "learner"
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_fleet_drill_broadcast_timeout_aborts_starved_worker(tmp_path):
+    """broadcast_timeout@2 on the learner: ordinal 2 is skipped, so the
+    staleness-0 worker's gate can never open for the next batch — its
+    collective_guard deadline converts the starvation into exit 117."""
+    fleet_dir = tmp_path / "fleet"
+    # The deadline must COVER the learner's first-batch compile+train (so a
+    # merely-slow publish is not an abort) while converting the injected
+    # never-published ordinal into one within the test budget.
+    env = {"TOTAL": "100", "EPOCHS": "100", "BDEADLINE": "30"}
+    worker = _launch_role(tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 0, env)
+    logs = {}
+    try:
+        learner = _launch_role(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 0,
+            {**env, "TRLX_TPU_FAULTS": "broadcast_timeout@2"},
+        )
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w = logs["worker.log"] = _communicate(worker)
+        assert worker.returncode == EXIT_COLLECTIVE_TIMEOUT, (
+            f"expected worker exit {EXIT_COLLECTIVE_TIMEOUT}, got "
+            f"{worker.returncode}:\n{out_w[-4000:]}"
+        )
+        # The starved worker's own guard names the broadcast site.
+        assert "fleet/weight_broadcast" in out_w
+        # The learner outlives it: stream dries up, peer triaged dead,
+        # degraded exit — never a hang on either side.
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert "[fleet] learner stopped cleanly" in out_l
+        events = _events(fleet_dir)
+        degraded = [e for e in events if e["event"] == "degraded"]
+        assert degraded and degraded[0]["triage"] == "dead"
+        broadcast = read_jsonl_or_empty(os.path.join(str(fleet_dir), "broadcast.jsonl"))
+        assert any(r["status"] == "injected_timeout" and r["ordinal"] == 2 for r in broadcast)
+        # The worker survived the slow-but-published ordinal 1 and streamed
+        # against it — only the never-published ordinal starved it.
+        stream = read_jsonl_or_empty(os.path.join(str(fleet_dir), "stream.jsonl"))
+        assert len(stream) >= 2
+        _assert_clean_threads(out_l, "learner")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_fleet_drill_episode_stream_stall_triages_stalled_not_dead(tmp_path):
+    """episode_stream_stall@2 on the worker: batch 2 never lands but the
+    worker's heartbeat thread keeps beating — fresh written_t, frozen
+    progress_t — so the learner's triage must say STALLED, not dead. The
+    stall is finite (30s) so the woken worker observes the abort marker and
+    exits 0 on its own."""
+    fleet_dir = tmp_path / "fleet"
+    env = {"TOTAL": "100", "EPOCHS": "100"}
+    worker = _launch_role(
+        tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 1,
+        {**env, "TRLX_TPU_FAULTS": "episode_stream_stall@2",
+         "TRLX_TPU_STREAM_STALL_SECONDS": "30"},
+    )
+    logs = {}
+    try:
+        learner = _launch_role(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 1, env)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w = logs["worker.log"] = _communicate(worker, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert worker.returncode == 0, f"worker failed:\n{out_w[-4000:]}"
+        events = _events(fleet_dir)
+        degraded = [e for e in events if e["event"] == "degraded"]
+        assert degraded and degraded[0]["triage"] == "stalled"
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["triage"] == "stalled"
+        # The per-episode retry wrapper fired before triage escalated.
+        assert "episode stream wait" in out_l
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w, "worker")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_two_process_staleness0_matches_serial_bitwise(tmp_path):
+    """The distributed acceptance identity: a REAL 2-process disaggregated
+    run at max_staleness=0 — episodes crossing process boundaries through
+    npz files, weights crossing back as byte-leaf snapshots, the adaptive
+    KL coefficient riding the pointer — reproduces the serial loss
+    trajectory bitwise."""
+    serial = _launch_role(tmp_path, "serial", tmp_path / "ckpt_s", tmp_path / "unused", 0)
+    out_s = _communicate(serial)
+    assert serial.returncode == 0, f"serial run failed:\n{out_s[-4000:]}"
+
+    fleet_dir = tmp_path / "fleet"
+    worker = _launch_role(tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 0)
+    logs = {}
+    try:
+        learner = _launch_role(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 0)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w = logs["worker.log"] = _communicate(worker, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert worker.returncode == 0, f"worker failed:\n{out_w[-4000:]}"
+
+        def losses(out):
+            line = next(l for l in out.splitlines() if l.startswith("LOSSES "))
+            return json.loads(line[len("LOSSES "):])
+
+        assert losses(out_s) == losses(out_l)
+        assert len(losses(out_s)) == 8
+
+        # On-policy throughout, lineage intact, coordinated completion.
+        consumed = [e for e in _events(fleet_dir) if e["event"] == "episode_consumed"]
+        assert consumed and all(e["staleness"] == 0 for e in consumed)
+        stream = read_jsonl_or_empty(os.path.join(str(fleet_dir), "stream.jsonl"))
+        broadcast = read_jsonl_or_empty(os.path.join(str(fleet_dir), "broadcast.jsonl"))
+        published = {r["version"] for r in broadcast if r["status"] == "published"}
+        assert {r["weight_version"] for r in stream} <= published
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] == "complete"
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w, "worker")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs)

@@ -1,0 +1,363 @@
+"""Unit tier for the disaggregated fleet transports (trlx_tpu/fleet).
+
+Everything here runs in-process and fast: construction-time config
+validation (the stray-knob error that replaced the RolloutProducer-era
+mid-run raise), the on-disk path contract, bitwise npz round-trips for
+both transports (episode stream AND weight broadcast), resume-safe
+seq/ordinal numbering, the shared staleness-gate predicate, the new fault
+kinds, and the effective-timeout resolution. The cross-process story —
+parity through the stream, degradation ladders, host-failure drills —
+lives in tests/test_fleet_disagg.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402,F401  (registers ml_dtypes via jax import)
+from randomwalks import base_config  # noqa: E402
+from trlx_tpu.fleet import (  # noqa: E402
+    EpisodeStreamReader,
+    EpisodeStreamTimeout,
+    EpisodeStreamWriter,
+    FleetPaths,
+    WeightPublisher,
+    WeightSubscriber,
+    fleet_paths,
+    put_leaves,
+    resolve_role,
+    role_timeouts,
+    validate_fleet_config,
+)
+from trlx_tpu.fleet.topology import ROLE_ENV, read_jsonl_or_empty  # noqa: E402
+from trlx_tpu.pipeline.overlap import staleness_gate_open  # noqa: E402
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage  # noqa: E402
+from trlx_tpu.resilience.faults import FaultPlan  # noqa: E402
+
+
+def _config(**train_overrides):
+    config = base_config("ppo", 15, 8)
+    for k, v in train_overrides.items():
+        setattr(config.train, k, v)
+    return config
+
+
+# ------------------------------------------------- construction-time checks
+
+
+def test_stray_fleet_knob_without_disaggregate_is_a_config_error():
+    """Satellite 1: fleet knobs set while method.fleet_disaggregate is off
+    must raise at validation (trainer construction), never mid-run."""
+    config = _config(fleet_episode_timeout=30.0)
+    with pytest.raises(ValueError, match="fleet_episode_timeout"):
+        validate_fleet_config(config)
+    with pytest.raises(ValueError, match="fleet_disaggregate"):
+        validate_fleet_config(config)
+
+
+def test_stray_role_env_without_disaggregate_is_a_config_error(monkeypatch):
+    monkeypatch.setenv(ROLE_ENV, "rollout")
+    with pytest.raises(ValueError, match=ROLE_ENV):
+        validate_fleet_config(_config())
+
+
+def test_no_fleet_config_validates_to_none(monkeypatch):
+    monkeypatch.delenv(ROLE_ENV, raising=False)
+    assert validate_fleet_config(_config()) is None
+
+
+def test_role_resolution_env_wins_over_config(monkeypatch):
+    config = _config(fleet_role="learner")
+    config.method.fleet_disaggregate = True
+    monkeypatch.delenv(ROLE_ENV, raising=False)
+    assert resolve_role(config) == "learner"
+    assert validate_fleet_config(config) == "learner"
+    monkeypatch.setenv(ROLE_ENV, "rollout")
+    assert resolve_role(config) == "rollout"
+    assert validate_fleet_config(config) == "rollout"
+
+
+def test_fleet_without_role_is_colocated(monkeypatch):
+    monkeypatch.delenv(ROLE_ENV, raising=False)
+    config = _config()
+    config.method.fleet_disaggregate = True
+    assert validate_fleet_config(config) == "colocated"
+
+
+def test_unknown_role_rejected(monkeypatch):
+    monkeypatch.delenv(ROLE_ENV, raising=False)
+    config = _config(fleet_role="replayer")
+    config.method.fleet_disaggregate = True
+    with pytest.raises(ValueError, match="replayer"):
+        validate_fleet_config(config)
+
+
+def test_fleet_and_rollout_overlap_are_mutually_exclusive(monkeypatch):
+    monkeypatch.delenv(ROLE_ENV, raising=False)
+    config = _config()
+    config.method.fleet_disaggregate = True
+    config.method.rollout_overlap = True
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_fleet_config(config)
+
+
+def test_trainer_constructor_rejects_stray_fleet_knobs(tmp_path):
+    """The end-to-end form of satellite 1: the error surfaces from trainer
+    construction inside trlx_tpu.train, before any training work."""
+    from randomwalks import generate_random_walks
+
+    _, logit_mask, metric_fn, reward_fn = generate_random_walks(
+        n_nodes=15, max_length=8, n_walks=10, seed=1000
+    )
+    config = _config(fleet_dir=str(tmp_path / "fleet"), checkpoint_dir=str(tmp_path / "ckpt"))
+    config.train.batch_size = 16
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    with pytest.raises(ValueError, match="fleet_dir"):
+        trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=[[1], [2]],
+            eval_prompts=[[1]],
+            metric_fn=metric_fn,
+            config=config,
+            logit_mask=logit_mask,
+        )
+
+
+# ------------------------------------------------------------ path contract
+
+
+def test_fleet_paths_layout_and_abort(tmp_path):
+    paths = FleetPaths(root=str(tmp_path / "fleet")).ensure()
+    assert os.path.isdir(paths.episodes_dir)
+    assert os.path.isdir(paths.weights_dir)
+    assert os.path.isdir(paths.heartbeats_dir)
+    assert paths.episode_file(3).endswith("batch_000003.npz")
+    assert paths.weight_file(7).endswith("weights_00000007.npz")
+    assert paths.read_abort() is None
+    with open(paths.abort, "w") as f:
+        f.write('{"reason": "compl')  # torn write mid-flight
+    assert paths.read_abort() is None
+    with open(paths.abort, "w") as f:
+        json.dump({"reason": "complete"}, f)
+    assert paths.read_abort()["reason"] == "complete"
+
+
+def test_fleet_paths_default_root_is_under_checkpoint_dir(tmp_path):
+    config = _config(checkpoint_dir=str(tmp_path / "ckpt"))
+    assert fleet_paths(config.train).root == str(tmp_path / "ckpt" / "fleet")
+    config = _config(fleet_dir=str(tmp_path / "shared"))
+    assert fleet_paths(config.train).root == str(tmp_path / "shared")
+
+
+def test_read_jsonl_or_empty_tolerates_absence_and_torn_tail(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    assert read_jsonl_or_empty(path) == []
+    with open(path, "w") as f:
+        f.write('{"seq": 0}\n{"seq": 1}\n{"seq": 2')  # torn tail
+    assert [r["seq"] for r in read_jsonl_or_empty(path)] == [0, 1]
+
+
+# ---------------------------------------------------------- episode stream
+
+
+def _columns(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "query_tensors": rng.integers(0, 15, (n, 3)).astype(np.int32),
+        "query_mask": np.ones((n, 3), np.int32),
+        "response_tensors": rng.integers(0, 15, (n, 5)).astype(np.int32),
+        "response_mask": np.ones((n, 5), np.int32),
+        "logprobs": rng.standard_normal((n, 5)).astype(np.float32),
+        "values": rng.standard_normal((n, 5)).astype(np.float32),
+        "rewards": rng.standard_normal((n, 5)).astype(np.float32),
+    }
+
+
+def test_stream_roundtrip_is_bitwise_and_indexed(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    writer = EpisodeStreamWriter(paths)
+    cols = _columns()
+    assert writer.append(cols, weight_version=12) == 0
+    reader = EpisodeStreamReader(paths)
+    rec = reader.poll(0)
+    assert rec["n"] == 4 and rec["weight_version"] == 12
+    got = reader.load(rec)
+    assert set(got) == set(cols)
+    for k in cols:
+        assert got[k].dtype == cols[k].dtype
+        assert np.array_equal(got[k], cols[k])
+
+
+def test_stream_columns_rebuild_a_storage_bitwise(tmp_path):
+    """The wire format IS PPORolloutStorage.columns(): round-tripping it
+    through the stream and push_batch rebuilds an identical store —
+    including the staleness column the fleet consumer appends."""
+    store = PPORolloutStorage(pad_token_id=0, record_staleness=True)
+    store.push_batch(_columns(seed=3))
+    cols = store.columns()
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    EpisodeStreamWriter(paths).append(cols, weight_version=0)
+    reader = EpisodeStreamReader(paths)
+    rebuilt = PPORolloutStorage(pad_token_id=0, record_staleness=True)
+    rebuilt.push_batch(reader.load(reader.poll(0)))
+    got = rebuilt.columns()
+    assert set(got) == set(cols)
+    for k in cols:
+        assert np.array_equal(got[k], cols[k])
+
+
+def test_stream_writer_resumes_seq_numbering(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    writer = EpisodeStreamWriter(paths)
+    writer.append(_columns(), weight_version=0)
+    writer.append(_columns(), weight_version=0)
+    # A restarted worker continues from the index, never clobbers.
+    assert EpisodeStreamWriter(paths).next_seq == 2
+
+
+def test_stream_reader_queued_from_cursor(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    writer = EpisodeStreamWriter(paths)
+    for _ in range(4):
+        writer.append(_columns(), weight_version=0)
+    reader = EpisodeStreamReader(paths)
+    assert [r["seq"] for r in reader.queued_from(2)] == [2, 3]
+    assert reader.queued_from(9) == []
+
+
+def test_stream_wait_times_out_through_retry_wrapper(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    reader = EpisodeStreamReader(paths)
+    start = time.monotonic()
+    with pytest.raises(EpisodeStreamTimeout, match="seq=5"):
+        reader.wait(5, timeout=0.15, retries=1, backoff=0.01)
+    # 2 attempts x ~0.15s + backoff, bounded — no hang, no watchdog thread.
+    assert time.monotonic() - start < 5.0
+
+
+def test_stream_wait_returns_when_batch_lands(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    EpisodeStreamWriter(paths).append(_columns(), weight_version=7)
+    rec = EpisodeStreamReader(paths).wait(0, timeout=1.0, retries=0, backoff=0.0)
+    assert rec["weight_version"] == 7
+
+
+# --------------------------------------------------------- weight broadcast
+
+
+def _params():
+    import jax.numpy as jnp
+
+    return {
+        "w": jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4) / 7,
+        "b": jnp.linspace(-1.0, 1.0, 5, dtype=jnp.float32),
+    }
+
+
+def test_broadcast_roundtrip_is_bitwise_even_for_bfloat16(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    params = _params()
+    pub = WeightPublisher(paths)
+    assert pub.publish(params, version=4, meta={"kl_coef": 0.125}) == 0
+    sub = WeightSubscriber(paths)
+    latest = sub.latest()
+    assert latest["ordinal"] == 0 and latest["version"] == 4
+    # Lockstep scalars ride the pointer with the weights (the adaptive KL
+    # coefficient shapes rollout rewards exactly like params shape tokens).
+    assert latest["kl_coef"] == 0.125
+    got = put_leaves(params, sub.load(latest))
+    for k in params:
+        assert got[k].dtype == params[k].dtype
+        raw_a = np.asarray(got[k]).view(np.uint8)
+        raw_b = np.asarray(params[k]).view(np.uint8)
+        assert np.array_equal(raw_a, raw_b), f"leaf {k} not bitwise"
+
+
+def test_put_leaves_rejects_mismatched_trees(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    params = _params()
+    WeightPublisher(paths).publish(params, version=0)
+    sub = WeightSubscriber(paths)
+    leaves = sub.load(sub.latest())
+    with pytest.raises(ValueError, match="leaf-count mismatch"):
+        put_leaves({"w": params["w"]}, leaves)
+    import jax.numpy as jnp
+
+    wrong = {"w": params["w"], "b": jnp.zeros(9, jnp.float32)}
+    with pytest.raises(ValueError, match="size mismatch"):
+        put_leaves(wrong, leaves)
+
+
+def test_broadcast_timeout_fault_skips_snapshot_but_logs_ordinal(tmp_path):
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    plan = FaultPlan.parse("broadcast_timeout@1")
+    pub = WeightPublisher(paths, fault_plan=plan)
+    params = _params()
+    pub.publish(params, version=0)
+    pub.publish(params, version=1)  # injected: no file, pointer stays put
+    pub.publish(params, version=2)
+    records = read_jsonl_or_empty(paths.broadcast_log)
+    assert [r["status"] for r in records] == ["published", "injected_timeout", "published"]
+    assert [r["ordinal"] for r in records] == [0, 1, 2]
+    assert not os.path.exists(paths.weight_file(1))
+    assert WeightSubscriber(paths).latest()["ordinal"] == 2
+    assert [r["ordinal"] for r in pub.published()] == [0, 2]
+    # Dense resume: injected ordinals still consumed a slot.
+    assert WeightPublisher(paths).next_ordinal == 3
+
+
+# ------------------------------------------------- gate / faults / timeouts
+
+
+def test_staleness_gate_predicate_is_shared_and_exact():
+    # seq - consumed <= S: the same predicate gates the in-process producer
+    # (pipeline/overlap.py) and the disaggregated worker (fleet/runner.py).
+    assert staleness_gate_open(0, 0, 0)
+    assert not staleness_gate_open(1, 0, 0)
+    assert staleness_gate_open(3, 1, 2)
+    assert not staleness_gate_open(4, 1, 2)
+    assert staleness_gate_open(5, 5, -3)  # negative caps clamp to 0
+
+
+def test_fault_plan_parses_fleet_kinds():
+    plan = FaultPlan.parse("rollout_host_kill@3,broadcast_timeout@1,episode_stream_stall@2")
+    assert plan.fire("rollout_host_kill", 3)
+    assert not plan.fire("rollout_host_kill", 3)  # one-shot
+    assert plan.fire("broadcast_timeout", 1)
+    assert plan.fire("episode_stream_stall", 2)
+    with pytest.raises(ValueError):
+        FaultPlan.parse("rollout_host_explode@1")
+
+
+def test_role_timeouts_resolve_documented_defaults():
+    t = _config().train
+    got = role_timeouts(t)
+    assert got["heartbeat_interval"] == 0.5
+    assert got["episode_timeout"] == 60.0
+    assert got["stream_retries"] == 2
+    assert got["stream_backoff"] == 0.5
+    assert got["heartbeat_timeout"] == 10.0
+    assert got["broadcast_deadline"] == 60.0
+    t = _config(
+        heartbeat_interval=2.0,
+        fleet_episode_timeout=5.0,
+        fleet_stream_retries=4,
+        fleet_stream_backoff=0.1,
+        fleet_heartbeat_timeout=9.0,
+        collective_deadline=45.0,
+    ).train
+    got = role_timeouts(t)
+    assert got["heartbeat_interval"] == 2.0
+    assert got["episode_timeout"] == 5.0
+    assert got["stream_retries"] == 4
+    assert got["stream_backoff"] == 0.1
+    assert got["heartbeat_timeout"] == 9.0
+    # fleet deadline falls back to the collective deadline before 60s.
+    assert got["broadcast_deadline"] == 45.0

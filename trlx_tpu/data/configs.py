@@ -185,6 +185,39 @@ class TrainConfig:
     # cost of one tiny allgather per N steps.
     preempt_check_interval: int = 0
 
+    # --- disaggregated fleet (trlx_tpu/fleet/) ---
+    # All knobs are inert unless method.fleet_disaggregate is set (and
+    # validated to be so at trainer construction — see
+    # trlx_tpu/fleet/topology.py). Each role runs as its OWN single-controller
+    # job; the two jobs couple only through the shared fleet directory.
+    #
+    # Which role this process plays: "rollout" | "learner" | "" (= colocated:
+    # both roles run serially in one process through the same stream/broadcast
+    # transports — the bitwise-parity mode). The TRLX_TPU_FLEET_ROLE env var
+    # overrides this field, so one config file serves both jobs of a drill.
+    fleet_role: str = ""
+    # Shared coupling directory holding the episode stream, the weight
+    # broadcasts, the per-role heartbeats, and the abort record. "" defaults
+    # to <checkpoint_dir>/fleet — fine colocated; disaggregated jobs with
+    # per-role checkpoint_dirs must point BOTH at one shared path.
+    fleet_dir: str = ""
+    # Per-episode-batch stream read: seconds to wait for the next streamed
+    # batch before one retry cycle (0 = 60s), bounded retries (0 = 2), and
+    # the exponential backoff base between them (0 = 0.5s) — the
+    # resilience/retry.py semantics, applied to the stream.
+    fleet_episode_timeout: float = 0.0
+    fleet_stream_retries: int = 0
+    fleet_stream_backoff: float = 0.0
+    # Declare the rollout role DEAD when its fleet heartbeat file goes
+    # unwritten this long, and STALLED when the file is fresh but its
+    # progress timestamp is older than this (0 = max(10x heartbeat_interval,
+    # 10s)). Drives the learner's degraded-drain state machine.
+    fleet_heartbeat_timeout: float = 0.0
+    # Rollout-side deadline (collective_guard semantics, exit 117 on expiry)
+    # on waiting for a weight broadcast the staleness gate requires
+    # (0 = train.collective_deadline, else 60s).
+    fleet_broadcast_deadline: float = 0.0
+
     # --- observability (trlx_tpu/observability/) ---
     # Cross-thread span tracing: host-side spans from the train loop, the
     # pipeline threads, checkpointing, and the collective guards land as

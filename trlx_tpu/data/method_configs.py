@@ -132,6 +132,19 @@ class PPOConfig(MethodConfig):
     # slots sit idle for at most this many steps before harvest+refill (the
     # occupancy cost of the amortization).
     engine_steps_per_sync: int = 8
+    # Disaggregated rollout/learner fleet (trlx_tpu/fleet): dedicated
+    # rollout and learner JOBS (each its own single-controller JAX world)
+    # coupled by a versioned weight broadcast and a bounded-staleness
+    # episode stream over train.fleet_dir — the LlamaRL/PipelineRL shape.
+    # max_staleness is the coupling knob: the rollout worker may run at most
+    # that many stream batches ahead of the learner's consume cursor, and
+    # must hold a weight version no older than the gate allows (staleness 0
+    # degenerates to the exact serial synchronous schedule — bitwise parity,
+    # tests/test_fleet_disagg.py). The per-process role comes from
+    # train.fleet_role / TRLX_TPU_FLEET_ROLE; unset = colocated (both roles
+    # in one process through the same transports). Off (default) keeps every
+    # existing path byte-identical.
+    fleet_disaggregate: bool = False
 
 
 @dataclass

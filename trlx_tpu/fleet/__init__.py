@@ -1,0 +1,53 @@
+"""Disaggregated rollout/learner fleet (ROADMAP: robustness pillar).
+
+Dedicated rollout and learner JOBS — each an independent single-controller
+JAX world — coupled only through ``train.fleet_dir``: a fault-tolerant
+episode stream (stream.py), a versioned weight broadcast (broadcast.py),
+and per-role heartbeats driving a degradation ladder (runner.py). Armed by
+``method.fleet_disaggregate``; per-process role from ``TRLX_TPU_FLEET_ROLE``
+or ``train.fleet_role``; no role = colocated single-process mode, the
+bitwise staleness-0 parity configuration (tests/test_fleet_disagg.py).
+"""
+
+from .broadcast import WeightPublisher, WeightSubscriber, put_leaves
+from .runner import FleetDegradedExit, FleetLearnerFeed, fleet_snapshot, run_rollout_worker
+from .stream import EpisodeStreamReader, EpisodeStreamTimeout, EpisodeStreamWriter
+from .topology import (
+    FLEET_TRAIN_KNOBS,
+    LEARNER_HOST,
+    ROLE_COLOCATED,
+    ROLE_ENV,
+    ROLE_LEARNER,
+    ROLE_ROLLOUT,
+    ROLLOUT_HOST,
+    FleetPaths,
+    fleet_paths,
+    resolve_role,
+    role_timeouts,
+    validate_fleet_config,
+)
+
+__all__ = [
+    "EpisodeStreamReader",
+    "EpisodeStreamTimeout",
+    "EpisodeStreamWriter",
+    "FLEET_TRAIN_KNOBS",
+    "FleetDegradedExit",
+    "FleetLearnerFeed",
+    "FleetPaths",
+    "LEARNER_HOST",
+    "ROLE_COLOCATED",
+    "ROLE_ENV",
+    "ROLE_LEARNER",
+    "ROLE_ROLLOUT",
+    "ROLLOUT_HOST",
+    "WeightPublisher",
+    "WeightSubscriber",
+    "fleet_paths",
+    "fleet_snapshot",
+    "put_leaves",
+    "resolve_role",
+    "role_timeouts",
+    "run_rollout_worker",
+    "validate_fleet_config",
+]

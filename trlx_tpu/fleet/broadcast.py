@@ -1,0 +1,190 @@
+"""Versioned weight broadcast: the learner→rollout transport.
+
+The learner publishes a flat-leaf snapshot of its params at every consume
+boundary (and once at bootstrap)::
+
+    weights_<ordinal>.npz          # leaves by position: leaf_000000, ...
+    broadcast.jsonl  += {"ordinal": k, "version": v, "file": ..., "status": "published", "t": ...}
+    weights_latest.json            # atomic pointer {ordinal, version, file}
+
+``ordinal`` is the dense publish counter (resume-safe: a restarted learner
+continues from the log length); ``version`` is the training iter_count the
+snapshot was taken at — the tag every episode carries (PR 9 lineage) and
+the key the per-version quant telemetry buckets by (PR 15). Leaves are
+matched POSITIONALLY: both worlds build the identical model from the same
+config/seed, so ``tree_flatten`` yields the same leaf order — a size
+mismatch is a hard error, never a silent misload. Each leaf is stored as
+its RAW BYTES (a uint8 view), not a typed array: the ``.npy`` format
+round-trips builtin dtypes only, and params are frequently bfloat16
+(an ml_dtypes extension type). Bytes in, bytes out — the transport is
+bitwise by construction, which the staleness-0 parity test leans on.
+
+The rollout side blocks for the version its staleness gate requires under
+``collective_guard("fleet/weight_broadcast", deadline=...)`` — the fleet
+has no raw collectives, but a worker starved of weights is semantically a
+peer stuck in a broadcast, so it gets the same treatment: heartbeat phase
+tagging, stall report, and a deadline abort with exit code 117
+(``EXIT_COLLECTIVE_TIMEOUT``). The ``broadcast_timeout@N`` fault fires in
+the publisher: ordinal N's snapshot is SKIPPED (logged as
+``status="injected_timeout"``), so a staleness-0 worker waiting for
+exactly that ordinal outlives its deadline.
+"""
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.resilience.checkpoint import atomic_write_json
+from trlx_tpu.resilience.distributed import collective_guard
+from trlx_tpu.utils.jsonl import append_record
+
+from .topology import FleetPaths, read_jsonl_or_empty
+
+BROADCAST_GUARD = "fleet/weight_broadcast"
+
+
+def _leaf_key(i: int) -> str:
+    return f"leaf_{i:06d}"
+
+
+class WeightPublisher:
+    """Learner-side publisher. One ``publish`` per consume boundary."""
+
+    def __init__(self, paths: FleetPaths, fault_plan=None):
+        self.paths = paths
+        self.fault_plan = fault_plan
+        records = read_jsonl_or_empty(paths.broadcast_log)
+        # Dense resume: injected-timeout records still consumed an ordinal.
+        self.next_ordinal = 1 + max((int(r["ordinal"]) for r in records), default=-1)
+
+    def publish(self, params, version: int, meta: Optional[dict] = None) -> int:
+        """Snapshot ``params`` (a device pytree) to disk and advance the
+        latest pointer. Returns the ordinal it landed at.
+
+        ``meta`` rides in the log record AND the latest pointer: small host
+        scalars the rollout side must track in lockstep with the weights —
+        today the adaptive KL coefficient (``kl_coef``), which shapes
+        rollout rewards exactly like the params shape rollout tokens. A
+        worker holding version-n params but a stale KL coefficient would
+        silently break the staleness-0 parity contract."""
+        import jax
+
+        ordinal = self.next_ordinal
+        self.next_ordinal = ordinal + 1
+        if self.fault_plan is not None and self.fault_plan.fire("broadcast_timeout", ordinal):
+            # Skip the snapshot entirely: the log records the injection (so
+            # lineage checks can filter status=="published") but no file
+            # lands and the latest pointer stays put.
+            append_record(
+                self.paths.broadcast_log,
+                {"ordinal": ordinal, "version": int(version), "file": None, "status": "injected_timeout", "t": time.time()},
+            )
+            return ordinal
+        leaves = jax.tree_util.tree_leaves(params)
+        host = jax.device_get(leaves)
+        path = self.paths.weight_file(ordinal)
+        tmp = f"{path}.tmp.{os.getpid()}.npz"
+        views = {}
+        for i, h in enumerate(host):
+            a = np.ascontiguousarray(np.asarray(h)).reshape(-1)
+            views[_leaf_key(i)] = a.view(np.uint8)
+        np.savez(tmp, **views)
+        os.replace(tmp, path)
+        rec = {
+            "ordinal": ordinal,
+            "version": int(version),
+            "file": os.path.basename(path),
+            "n_leaves": len(host),
+            "status": "published",
+            "t": time.time(),
+        }
+        pointer = {"ordinal": ordinal, "version": int(version), "file": rec["file"]}
+        if meta:
+            rec.update(meta)
+            pointer.update(meta)
+        append_record(self.paths.broadcast_log, rec)
+        atomic_write_json(self.paths.latest_pointer, pointer)
+        return ordinal
+
+    def published(self) -> List[dict]:
+        return [r for r in read_jsonl_or_empty(self.paths.broadcast_log) if r.get("status") == "published"]
+
+
+class WeightSubscriber:
+    """Rollout-side subscriber: poll the latest pointer, load host leaves."""
+
+    def __init__(self, paths: FleetPaths):
+        self.paths = paths
+
+    def latest(self) -> Optional[dict]:
+        """The latest pointer, or None. Torn-read tolerant."""
+        try:
+            with open(self.paths.latest_pointer, "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def load(self, record: dict) -> List[np.ndarray]:
+        path = os.path.join(self.paths.weights_dir, record["file"])
+        with np.load(path, allow_pickle=False) as z:
+            return [z[k] for k in sorted(z.files)]
+
+    def fetch(
+        self,
+        min_ordinal: int,
+        *,
+        deadline: float,
+        abort_check: Optional[Callable[[], bool]] = None,
+        heartbeat=None,
+        poll_interval: float = 0.05,
+    ) -> Optional[Tuple[dict, List[np.ndarray]]]:
+        """Block until a snapshot with ordinal >= ``min_ordinal`` is
+        published, under the collective guard's deadline. Returns
+        (pointer record, host leaves), or None if ``abort_check`` tripped
+        first (coordinated shutdown, not a fault). Deadline exceeded =
+        guard abort: exit EXIT_COLLECTIVE_TIMEOUT, never a hang."""
+        with collective_guard(BROADCAST_GUARD, deadline=max(0.1, float(deadline))):
+            while True:
+                rec = self.latest()
+                if rec is not None and int(rec["ordinal"]) >= int(min_ordinal):
+                    break
+                if abort_check is not None and abort_check():
+                    return None
+                if heartbeat is not None:
+                    heartbeat.beat(phase=f"collective:{BROADCAST_GUARD}")
+                time.sleep(poll_interval)
+        return rec, self.load(rec)
+
+
+def put_leaves(template_params, host_leaves: List[np.ndarray]):
+    """Map broadcast byte-leaves back onto a live param tree: positional
+    unflatten against THIS world's treedef, each byte blob re-viewed with
+    the reference leaf's dtype/shape and ``device_put`` with its sharding
+    (so the worker's mesh layout — not the learner's — decides placement).
+    Bitwise: no cast, no copy semantics beyond the host→device transfer."""
+    import jax
+
+    ref_leaves, treedef = jax.tree_util.tree_flatten(template_params)
+    if len(ref_leaves) != len(host_leaves):
+        raise ValueError(
+            f"weight broadcast leaf-count mismatch: snapshot has "
+            f"{len(host_leaves)} leaves, this world's param tree has "
+            f"{len(ref_leaves)} — the jobs are not running the same model "
+            "config."
+        )
+    put = []
+    for raw, ref in zip(host_leaves, ref_leaves):
+        dt = np.dtype(ref.dtype)
+        raw = np.asarray(raw)
+        if raw.nbytes != ref.size * dt.itemsize:
+            raise ValueError(
+                f"weight broadcast leaf size mismatch: {raw.nbytes} bytes vs "
+                f"expected {ref.size * dt.itemsize} for shape {ref.shape} "
+                f"{dt} — the jobs are not running the same model config."
+            )
+        host = raw.view(dt).reshape(ref.shape)
+        put.append(jax.device_put(host, getattr(ref, "sharding", None)))
+    return jax.tree_util.tree_unflatten(treedef, put)

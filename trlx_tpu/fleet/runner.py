@@ -1,0 +1,484 @@
+"""Fleet roles: the persistent rollout worker and the learner's episode feed.
+
+Two independent single-controller worlds (topology.py), three transports
+(episode stream, weight broadcast, heartbeats), one coupling knob
+(``method.max_staleness``). The schedule both sides enforce:
+
+- the worker may produce stream batch ``seq`` only when the learner's
+  cursor allows it (``staleness_gate_open(seq, consumed, S)`` — the SAME
+  predicate the in-process RolloutProducer gates on), and only from a
+  weight snapshot with publish ordinal >= ``seq - S``;
+- the learner publishes the post-train weights BEFORE advancing its
+  cursor, and the worker reads cursor-then-latest — so a just-opened gate
+  always sees the version that opened it.
+
+At S=0 this degenerates to the exact serial synchronous schedule (produce
+n from weights n, train on n, publish n+1, ...) — which is why the
+staleness-0 disaggregated run is bitwise-identical to the serial path
+(tests/test_fleet_disagg.py re-proves the PR 5 contract through the
+stream). At S>0 the worker runs ahead, LlamaRL-style, and every consumed
+batch's realized staleness (publish ordinals elapsed since its version)
+is written into the store's staleness column for the PR 9 lineage logs.
+
+Degradation ladder (the robustness core): a learner whose episode wait
+exhausts its timeout/retry/backoff budget triages the rollout role by
+heartbeat — DEAD (file age), STALLED (file fresh, progress frozen), or
+merely slow (keep waiting). Dead/stalled flips the feed to ``degraded``:
+the /healthz fleet block and the ``fleet/degraded`` gauge flip at ENTRY
+(so a scraper sees the state for the whole drain, not a final instant),
+queued in-flight batches are drained at their elevated staleness, and
+when the stream runs dry — or a batch exceeds the staleness cap — the
+feed raises ``FleetDegradedExit``: the trainer checkpoints (the rollback
+point), writes ``abort.json`` (coordinated shutdown: a stalled-but-alive
+worker reads it and exits 0), and winds down cleanly instead of hanging.
+"""
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from trlx_tpu.observability import numerics as obs_numerics
+from trlx_tpu.pipeline.overlap import staleness_gate_open
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.resilience.checkpoint import atomic_write_json
+from trlx_tpu.resilience.distributed import Heartbeat, read_heartbeats
+from trlx_tpu.utils.jsonl import append_record
+
+from .broadcast import WeightPublisher, WeightSubscriber, put_leaves
+from .stream import EpisodeStreamReader, EpisodeStreamWriter, EpisodeStreamTimeout
+from .topology import (
+    LEARNER_HOST,
+    ROLE_COLOCATED,
+    ROLE_ROLLOUT,
+    ROLLOUT_HOST,
+    FleetPaths,
+    fleet_paths,
+    read_jsonl_or_empty,
+    role_timeouts,
+)
+
+
+class FleetDegradedExit(RuntimeError):
+    """Coordinated fleet shutdown: the learner has drained what it can and
+    must stop consuming. Carries the triage verdict for the event log."""
+
+    def __init__(self, reason: str, triage: str = "", detail: str = ""):
+        super().__init__(f"fleet degraded exit: {reason}" + (f" ({detail})" if detail else ""))
+        self.reason = reason
+        self.triage = triage
+        self.detail = detail
+
+
+def fleet_snapshot(trainer, host_leaves, version: int) -> dict:
+    """Rebuild a rollout snapshot (the ``_rollout_snapshot`` contract) from
+    broadcast byte-leaves: params re-viewed + device_put onto THIS world's
+    shardings, the frozen ref branch deep-copied from the local state (it
+    never trains, and both worlds initialize it identically from the same
+    seed), and the int8 decode weights re-quantized locally when W8A16
+    decode is armed. Bitwise: npz bytes → device is a pure transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    with trainer._dispatch_lock:
+        params = put_leaves(trainer.state.params, host_leaves)
+        snap = {
+            "params": params,
+            # Deep copy, not a reference: at S>0 the snapshot outlives train
+            # steps that donate the live TrainState (same hazard
+            # _rollout_snapshot documents).
+            "extras": (
+                None
+                if trainer.state.extras is None
+                else jax.tree_util.tree_map(jnp.copy, trainer.state.extras)
+            ),
+            "version": int(version),
+        }
+        if trainer._qw is not None:
+            snap["qw"] = trainer._quantize_fn(snap["params"])
+        if obs_numerics.enabled():
+            # PR 15 tie-in: per-version quant telemetry at the handoff.
+            obs_numerics.record_weight_handoff(snap, version=snap["version"])
+    return snap
+
+
+def _read_cursor(paths: FleetPaths) -> int:
+    """The learner's consume cursor (count of consumed seqs). Missing or
+    torn = 0 — the worker just waits at the gate until it lands."""
+    import json
+
+    try:
+        with open(paths.cursor, "r") as f:
+            return int(json.load(f)["consumed"])
+    except (OSError, ValueError, KeyError):
+        return 0
+
+
+def _event(paths: FleetPaths, role: str, event: str, **fields):
+    rec = {"t": time.time(), "role": role, "event": event}
+    rec.update(fields)
+    append_record(paths.events, rec)
+
+
+# --------------------------------------------------------- rollout worker
+
+
+def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
+    """The persistent rollout job: wait at the staleness gate, hold the
+    newest eligible weights, generate one experience phase, stream it.
+
+    Runs INSTEAD of ``learn()`` when this process's fleet role is
+    ``rollout`` (trainer/api.py). Exits 0 on ``abort.json`` (coordinated
+    shutdown), 117 via the collective guard if the broadcast starves past
+    ``fleet_broadcast_deadline``, and abruptly (``os._exit(1)``) on the
+    ``rollout_host_kill`` fault."""
+    t = trainer.config.train
+    knobs = role_timeouts(t)
+    paths = fleet_paths(t).ensure()
+    S = trainer.max_staleness
+    n_roll = int(num_rollouts or trainer.config.method.num_rollouts)
+    heartbeat = Heartbeat(
+        paths.heartbeats_dir, knobs["heartbeat_interval"], process_index=ROLLOUT_HOST
+    )
+    heartbeat.start()
+    writer = EpisodeStreamWriter(paths, fault_plan=trainer.fault_plan)
+    subscriber = WeightSubscriber(paths)
+    _event(paths, ROLE_ROLLOUT, "worker_start", next_seq=writer.next_seq)
+
+    def aborted() -> bool:
+        return paths.read_abort() is not None
+
+    current_ordinal = -1
+    snapshot = None
+    try:
+        while not aborted():
+            seq = writer.next_seq
+            heartbeat.beat(step=seq, phase="fleet:gate")
+            if not staleness_gate_open(seq, _read_cursor(paths), S):
+                time.sleep(0.05)
+                continue
+            # Gate open: cursor read BEFORE the latest pointer, so the
+            # version whose publish opened the gate is already visible.
+            need = max(0, seq - S)
+            latest = subscriber.latest()
+            if latest is None or int(latest["ordinal"]) < need:
+                heartbeat.beat(step=seq, phase="fleet:wait_weights")
+                got = subscriber.fetch(
+                    need,
+                    deadline=knobs["broadcast_deadline"],
+                    abort_check=aborted,
+                    heartbeat=heartbeat,
+                )
+                if got is None:
+                    break  # coordinated shutdown while waiting
+                latest, leaves = got
+            elif int(latest["ordinal"]) != current_ordinal:
+                leaves = subscriber.load(latest)
+            else:
+                leaves = None
+            if leaves is not None:
+                snapshot = fleet_snapshot(trainer, leaves, latest["version"])
+                current_ordinal = int(latest["ordinal"])
+                if "kl_coef" in latest and getattr(trainer, "kl_ctl", None) is not None:
+                    # Track the learner's adaptive KL coefficient in
+                    # lockstep with the params (it shapes rollout rewards).
+                    trainer.kl_ctl.value = float(latest["kl_coef"])
+                _event(
+                    paths, ROLE_ROLLOUT, "weights_fetched",
+                    ordinal=current_ordinal, version=snapshot["version"], seq=seq,
+                )
+
+            store = PPORolloutStorage(trainer.pad_token_id, record_staleness=True)
+
+            def produce_stop():
+                heartbeat.beat(step=seq, phase="fleet:produce")
+                return aborted()
+
+            orch.make_experience(
+                n_roll,
+                iter_count=snapshot["version"],
+                store=store,
+                snapshot=snapshot,
+                staleness=0,  # realized staleness is stamped at consume time
+                stop=produce_stop,
+            )
+            if aborted():
+                break  # phase was cut short; drop the partial store
+            heartbeat.beat(step=seq, phase="fleet:stream")
+            writer.append(store.columns(), weight_version=snapshot["version"])
+            _event(
+                paths, ROLE_ROLLOUT, "episode_streamed",
+                seq=seq, version=snapshot["version"], n=len(store),
+            )
+            if trainer.fault_plan.fire("rollout_host_kill", seq):
+                os._exit(1)  # abrupt: no cleanup, no final heartbeat
+        _event(paths, ROLE_ROLLOUT, "worker_exit", reason="abort", next_seq=writer.next_seq)
+    finally:
+        heartbeat.stop()
+        if getattr(trainer, "heartbeat", None) is not None:
+            # The worker path never runs learn(), so the base trainer's own
+            # heartbeat thread must be joined here instead.
+            trainer.heartbeat.stop()
+
+
+# ----------------------------------------------------------- learner feed
+
+
+class FleetLearnerFeed:
+    """The learner's store source: one consumed stream batch per call.
+
+    Drives the publish-before-advance schedule, stamps realized staleness,
+    and owns the degradation ladder. In COLOCATED mode (fleet armed, no
+    role) it also runs the worker inline at each boundary — both roles in
+    one process, episodes still crossing the real npz transports, which is
+    the bitwise staleness-0 parity configuration."""
+
+    def __init__(self, trainer, orch=None):
+        self.trainer = trainer
+        self.orch = orch
+        t = trainer.config.train
+        self.role = trainer.fleet_role
+        self.max_staleness = trainer.max_staleness
+        self.knobs = role_timeouts(t)
+        self.paths = fleet_paths(t).ensure()
+        self.reader = EpisodeStreamReader(self.paths)
+        self.publisher = WeightPublisher(self.paths, fault_plan=trainer.fault_plan)
+        # version -> publish ordinal, for realized-staleness stamping
+        # (resume-aware: rebuilt from the log, injected entries included —
+        # they consumed an ordinal even though no snapshot landed).
+        self._version_ordinal = {
+            int(r["version"]): int(r["ordinal"]) for r in read_jsonl_or_empty(self.paths.broadcast_log)
+        }
+        self.consumed = _read_cursor(self.paths)
+        self.state = "ok"
+        self.triage = ""
+        self._abort_written = False
+        self._t0 = time.monotonic()
+        self.heartbeat = Heartbeat(
+            self.paths.heartbeats_dir, self.knobs["heartbeat_interval"], process_index=LEARNER_HOST
+        )
+        self.heartbeat.start()
+        # Colocated inline worker state.
+        self._writer = EpisodeStreamWriter(self.paths, fault_plan=trainer.fault_plan) if self.role == ROLE_COLOCATED else None
+        self._subscriber = WeightSubscriber(self.paths) if self.role == ROLE_COLOCATED else None
+        self._colo_ordinal = -1
+        self._colo_snapshot = None
+        _event(self.paths, self.role, "learner_start", consumed=self.consumed)
+        self._export(staleness=0.0)
+
+    # ------------------------------------------------------------- publish
+
+    def _publish(self):
+        tr = self.trainer
+        version = int(tr.iter_count)
+        # The adaptive KL coefficient travels WITH the weights: rollout
+        # rewards are kl_coef-shaped, so a worker on version-n params must
+        # also hold version-n's coefficient (post_epoch flushed the pending
+        # KL updates just before calling consume_done).
+        meta = {}
+        kl_ctl = getattr(tr, "kl_ctl", None)
+        if kl_ctl is not None:
+            meta["kl_coef"] = float(kl_ctl.value)
+        ordinal = self.publisher.publish(tr.state.params, version=version, meta=meta)
+        self._version_ordinal[version] = ordinal
+        if obs_numerics.enabled():
+            with tr._dispatch_lock:
+                obs_numerics.record_weight_quant(tr.state.params, version=version)
+        _event(self.paths, self.role, "weights_published", ordinal=ordinal, version=version)
+        return ordinal
+
+    def bootstrap(self) -> PPORolloutStorage:
+        """Iteration-0 fill: publish v0, then consume the first batch (the
+        colocated inline worker produces it; a disaggregated worker's gate
+        opens the moment the v0 pointer lands)."""
+        self._publish()
+        self.heartbeat.beat(step=self.trainer.iter_count, phase="fleet:bootstrap")
+        return self.next_store()
+
+    def consume_done(self):
+        """One train iteration fully consumed: publish the post-train
+        weights. Publish-BEFORE-advance is the ordering the staleness gate's
+        visibility argument rests on (the cursor only moves in
+        ``next_store`` → ``_consume``, after this)."""
+        self._publish()
+
+    # ------------------------------------------------------------- consume
+
+    def next_store(self) -> PPORolloutStorage:
+        if self.state == "degraded":
+            return self._drain_one()
+        if self._writer is not None:
+            self._inline_produce()
+        while True:
+            self.heartbeat.beat(step=self.trainer.iter_count, phase="fleet:wait_episode")
+            try:
+                rec = self.reader.wait(
+                    self.consumed,
+                    timeout=self.knobs["episode_timeout"],
+                    retries=self.knobs["stream_retries"],
+                    backoff=self.knobs["stream_backoff"],
+                )
+            except EpisodeStreamTimeout:
+                verdict = self._triage_rollout()
+                if verdict in ("alive", "starting"):
+                    # Slow but live (or still compiling): keep waiting — a
+                    # straggler is not a fault.
+                    _event(self.paths, self.role, "stream_slow", seq=self.consumed, triage=verdict)
+                    continue
+                self._enter_degraded(verdict)
+                return self._drain_one()
+            return self._consume(rec)
+
+    def _consume(self, rec: dict) -> PPORolloutStorage:
+        seq = int(rec["seq"])
+        version = int(rec["weight_version"])
+        latest_ordinal = self.publisher.next_ordinal - 1
+        v_ordinal = self._version_ordinal.get(version)
+        if v_ordinal is None:
+            # Lineage violation: an episode tagged with a version this
+            # learner never published. Surfaced loudly — the drills assert
+            # the event log has none of these.
+            _event(self.paths, self.role, "unknown_version", seq=seq, version=version)
+            v_ordinal = latest_ordinal
+        staleness = max(0, latest_ordinal - v_ordinal)
+        if staleness > self.max_staleness:
+            self._enter_degraded(self.triage or "staleness_cap")
+            raise FleetDegradedExit(
+                "staleness_cap",
+                triage=self.triage,
+                detail=f"seq={seq} staleness={staleness} > cap={self.max_staleness}",
+            )
+        cols = dict(self.reader.load(rec))
+        n = int(rec.get("n", 0))
+        cols["staleness"] = np.full((n, 1), float(staleness), dtype=np.float32)
+        store = PPORolloutStorage(self.trainer.pad_token_id, record_staleness=True)
+        store.push_batch(cols)
+        self.consumed = seq + 1
+        atomic_write_json(
+            self.paths.cursor,
+            {"consumed": self.consumed, "ordinal": latest_ordinal, "t": time.time()},
+        )
+        _event(
+            self.paths, self.role, "episode_consumed",
+            seq=seq, version=version, staleness=staleness, n=n, state=self.state,
+        )
+        self._export(staleness=float(staleness), version=version)
+        return store
+
+    # ---------------------------------------------------------- colocated
+
+    def _inline_produce(self):
+        """Colocated mode: run the worker's loop body inline until the gate
+        closes — same transports, same schedule, one process."""
+        tr = self.trainer
+        while staleness_gate_open(self._writer.next_seq, self.consumed, self.max_staleness):
+            seq = self._writer.next_seq
+            latest = self._subscriber.latest()
+            if latest is None or int(latest["ordinal"]) < max(0, seq - self.max_staleness):
+                raise RuntimeError(
+                    "colocated fleet invariant broken: gate open but no "
+                    "eligible weight snapshot published"
+                )
+            if int(latest["ordinal"]) != self._colo_ordinal:
+                leaves = self._subscriber.load(latest)
+                self._colo_snapshot = fleet_snapshot(tr, leaves, latest["version"])
+                self._colo_ordinal = int(latest["ordinal"])
+            store = PPORolloutStorage(tr.pad_token_id, record_staleness=True)
+            self.orch.make_experience(
+                tr.config.method.num_rollouts,
+                iter_count=self._colo_snapshot["version"],
+                store=store,
+                snapshot=self._colo_snapshot,
+                staleness=0,
+                stop=None,
+            )
+            self._writer.append(store.columns(), weight_version=self._colo_snapshot["version"])
+            _event(
+                self.paths, self.role, "episode_streamed",
+                seq=seq, version=self._colo_snapshot["version"], n=len(store),
+            )
+
+    # --------------------------------------------------------- degradation
+
+    def _triage_rollout(self) -> str:
+        """Classify the rollout role from its fleet heartbeat: 'dead'
+        (written_t stale — process gone), 'stalled' (file fresh, progress_t
+        frozen — thread alive, work wedged), 'alive' (progressing), or
+        'starting' (no heartbeat yet, within the startup grace)."""
+        timeout = self.knobs["heartbeat_timeout"]
+        recs = read_heartbeats(self.paths.heartbeats_dir)
+        rec = recs.get(ROLLOUT_HOST)
+        now = time.time()
+        if rec is None:
+            grace = max(120.0, 10.0 * timeout)
+            return "starting" if time.monotonic() - self._t0 < grace else "dead"
+        if now - float(rec.get("written_t", 0.0)) > timeout:
+            return "dead"
+        if now - float(rec.get("progress_t", 0.0)) > timeout:
+            return "stalled"
+        return "alive"
+
+    def _enter_degraded(self, triage: str):
+        if self.state == "degraded":
+            return
+        self.state = "degraded"
+        self.triage = triage
+        # Flip the health surface FIRST: every scrape during the drain (and
+        # the trainer's subsequent checkpoint) sees fleet/degraded.
+        self._export(staleness=None)
+        _event(
+            self.paths, self.role, "degraded",
+            triage=triage, consumed=self.consumed,
+            queued=len(self.reader.queued_from(self.consumed)),
+        )
+
+    def _drain_one(self) -> PPORolloutStorage:
+        """Degraded: hand over the next queued in-flight batch (tagged with
+        its now-elevated staleness), or raise when the stream is dry."""
+        queued = self.reader.queued_from(self.consumed)
+        if not queued:
+            raise FleetDegradedExit("stream_dry", triage=self.triage)
+        _event(
+            self.paths, self.role, "drain",
+            seq=int(queued[0]["seq"]), remaining=len(queued), triage=self.triage,
+        )
+        return self._consume(queued[0])
+
+    # ------------------------------------------------------------ teardown
+
+    def shutdown(self, reason: str = "complete"):
+        """Learner-side teardown. Writes ``abort.json`` — the coordinated
+        shutdown signal the worker polls — EXCEPT on preemption: a
+        preempted learner resumes into the same fleet_dir, and the worker
+        (live the whole time) must keep serving it."""
+        if reason != "preempted" and not self._abort_written:
+            atomic_write_json(
+                self.paths.abort,
+                {"reason": reason, "triage": self.triage, "consumed": self.consumed, "t": time.time()},
+            )
+            self._abort_written = True
+        _event(self.paths, self.role, "learner_exit", reason=reason, consumed=self.consumed)
+        self.heartbeat.stop()
+
+    # --------------------------------------------------------- observability
+
+    def _export(self, staleness=None, version=None):
+        exporter = getattr(self.trainer, "_metrics_exporter", None)
+        payload = {
+            "state": self.state,
+            "role": self.role,
+            "triage": self.triage,
+            "consumed": self.consumed,
+            "published": self.publisher.next_ordinal,
+            "max_staleness": self.max_staleness,
+        }
+        if exporter is None:
+            return
+        gauges = {"fleet/degraded": 1.0 if self.state == "degraded" else 0.0}
+        if staleness is not None:
+            gauges["fleet/staleness"] = float(staleness)
+        if version is not None:
+            gauges["fleet/weight_version"] = float(version)
+        exporter.update(gauges)
+        exporter.set_fleet({"disaggregated": payload})

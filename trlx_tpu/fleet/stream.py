@@ -1,0 +1,146 @@
+"""Fault-tolerant episode streaming: the rollout→learner transport.
+
+One stream batch = one finished ``make_experience`` phase, shipped as the
+store's raw column dict (``PPORolloutStorage.columns()``) in a single
+``.npz`` written atomically (tmp + ``os.replace``), plus one line in the
+append-only ``stream.jsonl`` index::
+
+    {"seq": 3, "file": "batch_000003.npz", "n": 64, "weight_version": 12, "t": ...}
+
+The npz round-trip is bitwise-lossless for every column dtype (int32
+tokens/masks, float32 stats), which is what lets the staleness-0
+disaggregated run re-prove the PR 5 serial-parity contract THROUGH the
+stream rather than around it (tests/test_fleet_disagg.py).
+
+Reader semantics: consume strictly in ``seq`` order (the learner's train
+schedule is deterministic given the stream order); each wait is wrapped in
+``resilience.retry.call_with_retries`` — per-episode timeout, bounded
+retries, exponential backoff — so a transient filesystem hiccup is retried
+and only a persistent stall escalates to the heartbeat triage in
+runner.py. Torn index tails (a writer killed mid-line) are tolerated by
+``utils.jsonl.read_jsonl``.
+
+The ``episode_stream_stall@N`` fault fires HERE, in the writer: batch N's
+append sleeps instead of writing while the worker's heartbeat thread keeps
+beating — fresh ``written_t``, frozen ``progress_t`` — exactly the
+signature the learner's triage must classify as STALLED (not DEAD).
+"""
+
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from trlx_tpu.resilience.retry import call_with_retries
+from trlx_tpu.utils.jsonl import append_record
+
+from .topology import FleetPaths, read_jsonl_or_empty
+
+
+class EpisodeStreamTimeout(RuntimeError):
+    """A stream wait exhausted its per-attempt timeout (retryable; the
+    caller's retry wrapper decides when it becomes a triage event)."""
+
+
+def _atomic_savez(path: str, columns: Dict[str, np.ndarray]):
+    # np.savez appends ".npz" to names that lack it, so the tmp name must
+    # already end in .npz for os.replace to find what savez wrote.
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(tmp, **{k: np.asarray(v) for k, v in columns.items()})
+    os.replace(tmp, path)
+
+
+def load_columns(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+class EpisodeStreamWriter:
+    """Rollout-side appender. Resume-aware: a restarted worker continues
+    ``seq`` numbering from the existing index instead of clobbering it."""
+
+    def __init__(self, paths: FleetPaths, fault_plan=None):
+        self.paths = paths
+        self.fault_plan = fault_plan
+        records = read_jsonl_or_empty(paths.stream_index)
+        self.next_seq = 1 + max((int(r["seq"]) for r in records), default=-1)
+
+    def append(self, columns: Dict[str, np.ndarray], weight_version: int) -> int:
+        """Write one episode batch atomically and index it. Returns seq."""
+        seq = self.next_seq
+        if self.fault_plan is not None and self.fault_plan.fire("episode_stream_stall", seq):
+            # Stall INSTEAD of writing: the batch never lands, but the
+            # worker process (and its heartbeat thread) stays alive.
+            time.sleep(float(os.environ.get("TRLX_TPU_STREAM_STALL_SECONDS", "3600")))
+        path = self.paths.episode_file(seq)
+        _atomic_savez(path, columns)
+        n = int(next(iter(columns.values())).shape[0]) if columns else 0
+        append_record(
+            self.paths.stream_index,
+            {
+                "seq": seq,
+                "file": os.path.basename(path),
+                "n": n,
+                "weight_version": int(weight_version),
+                "t": time.time(),
+            },
+        )
+        self.next_seq = seq + 1
+        return seq
+
+
+class EpisodeStreamReader:
+    """Learner-side sequential reader with timeout/retry/backoff waits."""
+
+    def __init__(self, paths: FleetPaths):
+        self.paths = paths
+
+    def index(self) -> Dict[int, dict]:
+        return {int(r["seq"]): r for r in read_jsonl_or_empty(self.paths.stream_index)}
+
+    def poll(self, seq: int) -> Optional[dict]:
+        return self.index().get(int(seq))
+
+    def queued_from(self, seq: int) -> list:
+        """Index records for every landed batch with seq >= the cursor — the
+        degraded-drain worklist."""
+        return [r for s, r in sorted(self.index().items()) if s >= int(seq)]
+
+    def load(self, record: dict) -> Dict[str, np.ndarray]:
+        return load_columns(os.path.join(self.paths.episodes_dir, record["file"]))
+
+    def wait(
+        self,
+        seq: int,
+        *,
+        timeout: float,
+        retries: int,
+        backoff: float,
+        poll_interval: float = 0.05,
+    ) -> dict:
+        """Block until batch ``seq`` lands in the index.
+
+        Each ATTEMPT polls for up to ``timeout`` seconds then raises
+        EpisodeStreamTimeout; call_with_retries re-attempts with doubling
+        backoff. Exhaustion re-raises — the runner's triage takes over."""
+
+        def attempt():
+            deadline = time.monotonic() + max(0.1, float(timeout))
+            while time.monotonic() < deadline:
+                rec = self.poll(seq)
+                if rec is not None:
+                    return rec
+                time.sleep(poll_interval)
+            raise EpisodeStreamTimeout(
+                f"episode batch seq={seq} did not land within {timeout}s "
+                f"(index {self.paths.stream_index})"
+            )
+
+        return call_with_retries(
+            attempt,
+            retries=max(0, int(retries)),
+            backoff=max(0.0, float(backoff)),
+            timeout=0.0,  # the attempt bounds itself; no watchdog thread
+            description=f"episode stream wait seq={seq}",
+        )

@@ -1,0 +1,240 @@
+"""Fleet topology: role resolution, construction-time validation, and the
+shared-directory layout that couples the disaggregated jobs.
+
+The disaggregation model (ROADMAP pillar: robustness; the LlamaRL /
+PipelineRL shape): the rollout side and the learner side run as SEPARATE
+single-controller JAX worlds — two independent processes (or pods), each
+seeing only its own devices, never sharing a ``jax.distributed`` runtime.
+Coupling is entirely through ``train.fleet_dir``:
+
+- episodes stream learner-ward through a bounded queue of atomic ``.npz``
+  batches + a line-atomic index (stream.py);
+- versioned weights broadcast rollout-ward through atomic ``.npz``
+  snapshots + an append-only broadcast log (broadcast.py);
+- liveness flows both ways through per-role heartbeat files (the same
+  ``resilience.distributed.Heartbeat`` wire format the multi-host hang
+  guard reads).
+
+Separate worlds is the load-bearing choice: a single multi-controller
+world running generation on some hosts and training on others cannot
+guarantee identical collective launch order (the exact deadlock the
+single-host guards in trainer/ppo.py exist to prevent). Two worlds have
+no shared collectives at all, so each side may freely use threads,
+pipelining, and the continuous-batching engine — and a dead peer can
+never wedge a collective, only starve a queue, which is detectable and
+drainable (runner.py's degradation ladder).
+
+Role resolution: ``TRLX_TPU_FLEET_ROLE`` env wins over
+``train.fleet_role`` so one config file can serve both jobs of a drill.
+No role with ``method.fleet_disaggregate`` set = COLOCATED mode — both
+roles run serially in one process through the real transports (the
+bitwise staleness-0 parity path, tests/test_fleet_disagg.py).
+"""
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+def read_jsonl_or_empty(path: str) -> list:
+    """Torn-tail-tolerant jsonl read that also tolerates ABSENCE — every
+    fleet log starts empty and appears on first append."""
+    from trlx_tpu.utils.jsonl import read_jsonl
+
+    return read_jsonl(path) if os.path.exists(path) else []
+
+
+ROLE_ENV = "TRLX_TPU_FLEET_ROLE"
+ROLE_ROLLOUT = "rollout"
+ROLE_LEARNER = "learner"
+ROLE_COLOCATED = "colocated"  # internal: fleet on, no per-process role
+
+# Heartbeat file indices inside <fleet_dir>/heartbeats/. Each role is
+# process 0 of its OWN JAX world, so jax.process_index() would collide both
+# roles onto host_0.json — the fleet heartbeat directory instead keys files
+# by role (Heartbeat(..., process_index=<role index>)).
+LEARNER_HOST = 0
+ROLLOUT_HOST = 1
+ROLE_HOSTS = {ROLE_LEARNER: LEARNER_HOST, ROLE_COLOCATED: LEARNER_HOST, ROLE_ROLLOUT: ROLLOUT_HOST}
+
+# Every train.* fleet knob, for the construction-time validation sweep.
+FLEET_TRAIN_KNOBS = (
+    "fleet_role",
+    "fleet_dir",
+    "fleet_episode_timeout",
+    "fleet_stream_retries",
+    "fleet_stream_backoff",
+    "fleet_heartbeat_timeout",
+    "fleet_broadcast_deadline",
+)
+
+
+@dataclass(frozen=True)
+class FleetPaths:
+    """The on-disk contract between the jobs, derived from one root.
+
+    Everything under the root is either written atomically (tmp + rename:
+    episode batches, weight snapshots, latest pointer, cursor, abort) or
+    append-only line-atomic jsonl (stream index, broadcast log, event
+    log), so a reader never observes a torn artifact — the same discipline
+    as resilience/checkpoint.py and the heartbeat files.
+    """
+
+    root: str
+
+    @property
+    def episodes_dir(self) -> str:
+        return os.path.join(self.root, "episodes")
+
+    @property
+    def weights_dir(self) -> str:
+        return os.path.join(self.root, "weights")
+
+    @property
+    def heartbeats_dir(self) -> str:
+        return os.path.join(self.root, "heartbeats")
+
+    @property
+    def stream_index(self) -> str:
+        # Append-only episode index: {seq, file, n, weight_version, t}.
+        return os.path.join(self.root, "stream.jsonl")
+
+    @property
+    def broadcast_log(self) -> str:
+        # Append-only weight-publish log: {ordinal, version, file, status, t}.
+        return os.path.join(self.root, "broadcast.jsonl")
+
+    @property
+    def latest_pointer(self) -> str:
+        # Atomic pointer to the freshest published snapshot.
+        return os.path.join(self.root, "weights_latest.json")
+
+    @property
+    def cursor(self) -> str:
+        # Learner's consume cursor — the staleness gate's denominator.
+        return os.path.join(self.root, "learner_cursor.json")
+
+    @property
+    def abort(self) -> str:
+        # Coordinated-shutdown marker: learner writes it on completion or
+        # degraded exit (NOT on preemption); the worker polls it and exits 0.
+        return os.path.join(self.root, "abort.json")
+
+    @property
+    def events(self) -> str:
+        # Authoritative fleet event log (degradation transitions, drains,
+        # staleness-cap exits) — what the drills assert on, what CI uploads.
+        return os.path.join(self.root, "fleet_events.jsonl")
+
+    def ensure(self) -> "FleetPaths":
+        for d in (self.root, self.episodes_dir, self.weights_dir, self.heartbeats_dir):
+            os.makedirs(d, exist_ok=True)
+        return self
+
+    def episode_file(self, seq: int) -> str:
+        return os.path.join(self.episodes_dir, f"batch_{int(seq):06d}.npz")
+
+    def weight_file(self, ordinal: int) -> str:
+        # Keyed by ordinal, not version: a resumed learner re-publishes its
+        # restored iter_count as a fresh ordinal, and versions may repeat.
+        return os.path.join(self.weights_dir, f"weights_{int(ordinal):08d}.npz")
+
+    def read_abort(self) -> Optional[dict]:
+        """The abort record, or None. Torn-read tolerant (atomic writer, but
+        the file may appear between existence check and open)."""
+        try:
+            with open(self.abort, "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+def fleet_paths(train_cfg) -> FleetPaths:
+    """Resolve the shared fleet directory: ``train.fleet_dir`` or
+    ``<checkpoint_dir>/fleet``. Disaggregated jobs keep PRIVATE
+    checkpoint_dirs (each world checkpoints alone) and share only this."""
+    root = train_cfg.fleet_dir or os.path.join(train_cfg.checkpoint_dir, "fleet")
+    return FleetPaths(root=root)
+
+
+def resolve_role(config) -> Optional[str]:
+    """This process's fleet role, or None when fleet mode is off entirely.
+
+    ``TRLX_TPU_FLEET_ROLE`` wins over ``train.fleet_role`` (the same
+    env-over-config convention as TRLX_TPU_FAULTS) so a drill launches both
+    jobs from one config. Fleet armed with no role = COLOCATED."""
+    if not getattr(config.method, "fleet_disaggregate", False):
+        return None
+    role = os.environ.get(ROLE_ENV, "") or config.train.fleet_role or ROLE_COLOCATED
+    return role
+
+
+def validate_fleet_config(config) -> Optional[str]:
+    """Construction-time fleet validation — called from PPOTrainer.__init__
+    so every misconfiguration is a ValueError at trainer construction, never
+    a mid-run raise (the RolloutProducer-era failure mode this replaces).
+
+    Returns the resolved role (None / 'rollout' / 'learner' / 'colocated').
+    """
+    import jax
+
+    t = config.train
+    env_role = os.environ.get(ROLE_ENV, "")
+    set_knobs = [k for k in FLEET_TRAIN_KNOBS if getattr(t, k, None)]
+    if not getattr(config.method, "fleet_disaggregate", False):
+        if set_knobs or env_role:
+            knobs = [f"train.{k}" for k in set_knobs]
+            if env_role:
+                knobs.append(f"{ROLE_ENV}={env_role!r}")
+            raise ValueError(
+                "fleet knobs are set but method.fleet_disaggregate is off: "
+                + ", ".join(knobs)
+                + ". Set method.fleet_disaggregate=true to run the "
+                "disaggregated rollout/learner fleet (trlx_tpu/fleet), or "
+                "clear these knobs — they are ignored otherwise, which is "
+                "never what a fleet drill wants."
+            )
+        return None
+
+    role = resolve_role(config)
+    if role not in (ROLE_ROLLOUT, ROLE_LEARNER, ROLE_COLOCATED):
+        raise ValueError(
+            f"unknown fleet role {role!r} (from {ROLE_ENV} or "
+            f"train.fleet_role) — expected '{ROLE_ROLLOUT}', "
+            f"'{ROLE_LEARNER}', or unset (colocated single-process mode)."
+        )
+    if jax.process_count() > 1:
+        raise ValueError(
+            "method.fleet_disaggregate couples SEPARATE single-controller "
+            "JAX worlds through train.fleet_dir — each role must be its own "
+            f"jax.distributed world (this one has {jax.process_count()} "
+            "processes). Launch the rollout and learner jobs as independent "
+            "processes instead of one multi-controller world."
+        )
+    if getattr(config.method, "rollout_overlap", False):
+        raise ValueError(
+            "method.rollout_overlap (in-process producer thread) and "
+            "method.fleet_disaggregate (cross-job episode stream) are "
+            "mutually exclusive — the fleet already overlaps rollouts with "
+            "training across jobs; method.max_staleness is the coupling "
+            "knob for both. Disable one."
+        )
+    return role
+
+
+def role_timeouts(t) -> dict:
+    """Effective fleet timing knobs with the documented 0-defaults resolved
+    (configs.py keeps raw zeros so GL005's falsy-default rule holds)."""
+    heartbeat_interval = float(t.heartbeat_interval or 0.5)
+    return {
+        "heartbeat_interval": heartbeat_interval,
+        "episode_timeout": float(t.fleet_episode_timeout or 60.0),
+        "stream_retries": int(t.fleet_stream_retries or 2),
+        "stream_backoff": float(t.fleet_stream_backoff or 0.5),
+        "heartbeat_timeout": float(
+            t.fleet_heartbeat_timeout or max(10.0 * heartbeat_interval, 10.0)
+        ),
+        "broadcast_deadline": float(
+            t.fleet_broadcast_deadline or t.collective_deadline or 60.0
+        ),
+    }

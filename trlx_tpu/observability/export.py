@@ -164,10 +164,18 @@ class MetricsExporter:
                 self._health = health
 
     def set_fleet(self, payload):
-        """Attach graftfleet's fleet block (per-host heartbeat ages, desync
-        status, straggler verdict, clock estimate) to /healthz."""
+        """Attach a fleet block (graftfleet's per-host heartbeat ages /
+        desync / straggler verdict, or the disaggregation feed's
+        ``disaggregated`` state) to /healthz. Dict payloads MERGE key-wise:
+        the two feeds own disjoint top-level keys and must not clobber each
+        other's block."""
         with self._lock:
-            self._fleet = payload
+            if isinstance(payload, dict) and isinstance(self._fleet, dict):
+                merged = dict(self._fleet)
+                merged.update(payload)
+                self._fleet = merged
+            else:
+                self._fleet = payload
 
     def observe(self, key: str, values, buckets, labels: dict = None):
         """Fold ``values`` into the cumulative histogram ``key`` (creating

@@ -24,6 +24,12 @@ Everything here is plain ``threading`` over the existing phase code — no new
 dependencies, and ALL of it is off unless the method config sets
 ``rollout_overlap`` / ``max_staleness`` (the serial schedule stays the
 byte-compatible default).
+
+Single-process scope: the producer here double-buffers WITHIN one process.
+The disaggregated rollout/learner fleet (trlx_tpu/fleet,
+method.fleet_disaggregate) runs the same staleness gate — shared via
+:func:`staleness_gate_open` — across two separate jobs coupled by an episode
+stream and a versioned weight broadcast.
 """
 
 import queue
@@ -35,6 +41,16 @@ from contextlib import contextmanager
 from trlx_tpu.observability import graftscope
 from trlx_tpu.observability.spans import trace_span
 from trlx_tpu.utils import sanitize
+
+
+def staleness_gate_open(index: int, consumed: int, max_staleness: int) -> bool:
+    """THE staleness gate, shared by RolloutProducer (in-process double
+    buffering) and the fleet rollout worker (cross-job episode stream):
+    production of store/batch ``index`` may start iff the consumer is at most
+    ``max_staleness`` iterations behind it. Pure counters — deterministic, so
+    every participant derives the identical schedule. At max_staleness=0 the
+    producer and consumer strictly alternate: the exact serial schedule."""
+    return index - consumed <= max(0, int(max_staleness))
 
 
 class PhaseTimer:
@@ -310,7 +326,9 @@ class RolloutProducer:
         index = 1
         while True:
             with self._cv:
-                while not self._stop.is_set() and index - self._consumed > self.max_staleness:
+                while not self._stop.is_set() and not staleness_gate_open(
+                    index, self._consumed, self.max_staleness
+                ):
                     self._cv.wait(timeout=0.5)
                 if self._stop.is_set():
                     return

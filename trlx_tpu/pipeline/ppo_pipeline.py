@@ -194,6 +194,15 @@ class PPORolloutStorage(BaseRolloutStore):
         if self._buffer is not None:
             self._buffer.clear()
 
+    def columns(self) -> Dict[str, np.ndarray]:
+        """All stored rows as one column dict — the episode-stream wire
+        format (trlx_tpu/fleet/stream.py): round-tripping these arrays
+        through ``push_batch`` on the receiving side rebuilds a
+        bitwise-identical store. Empty dict when nothing was pushed."""
+        if self._buffer is None or len(self._buffer) == 0:
+            return {}
+        return self._buffer.gather(np.arange(len(self._buffer)))
+
     def __len__(self) -> int:
         return 0 if self._buffer is None else len(self._buffer)
 

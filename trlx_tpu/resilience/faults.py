@@ -66,6 +66,28 @@ Multi-host kinds (fired per PROCESS — a 2-process drill sets a different
 - ``host_desync@N``  — this process's local copy of a replicated param leaf
                        is skewed after step N → exercises the cross-host
                        consistency guard (``HostDesync``).
+
+Fleet kinds (disaggregated rollout/learner jobs, trlx_tpu/fleet; fired per
+PROCESS like the multi-host kinds — a 2-process disaggregation drill sets a
+different ``TRLX_TPU_FAULTS`` on each role; tests/test_fleet_disagg.py):
+
+- ``rollout_host_kill@N``    — the rollout worker dies abruptly
+                       (``os._exit(1)``) right after streaming episode batch
+                       N → the learner's heartbeat triage flags the role
+                       DEAD, drains the in-flight batches at elevated
+                       staleness under ``fleet/degraded``, and exits cleanly
+                       at the staleness cap;
+- ``episode_stream_stall@N`` — the stream writer sleeps
+                       ``TRLX_TPU_STREAM_STALL_SECONDS`` (default 3600)
+                       INSTEAD of writing batch N, heartbeat thread still
+                       beating → written_t stays fresh while progress_t
+                       ages: the learner's triage distinguishes STALLED
+                       from DEAD;
+- ``broadcast_timeout@N``    — the learner SKIPS publishing weight version
+                       ordinal N → the rollout worker's guarded wait for
+                       the version its staleness gate requires outlives
+                       ``train.fleet_broadcast_deadline`` and aborts with
+                       ``CollectiveTimeout`` (exit 117).
 """
 
 import os
@@ -90,6 +112,9 @@ KINDS = (
     "host_kill",
     "slow_host",
     "host_desync",
+    "rollout_host_kill",
+    "episode_stream_stall",
+    "broadcast_timeout",
 )
 
 _ENTRY_RE = re.compile(r"^([a-z_]+)@(\d+)$")

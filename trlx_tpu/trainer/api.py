@@ -87,7 +87,16 @@ def train(
         orch = get_orchestrator(config.train.orchestrator)(
             model, pipeline, reward_fn=reward_fn, metric_fn=metric_fn, chunk_size=config.method.chunk_size
         )
-        orch.make_experience(config.method.num_rollouts)
+        fleet_role = getattr(model, "fleet_role", None)
+        if fleet_role is None:
+            orch.make_experience(config.method.num_rollouts)
+        elif fleet_role != "rollout":
+            # Fleet learner/colocated: iteration 0's experience arrives
+            # through the episode stream (trlx_tpu/fleet), after the v0
+            # weight broadcast that lets a worker's staleness gate open.
+            model._fleet_bootstrap()
+        # Fleet rollout role: no pre-learn fill — the worker loop below
+        # produces on demand, gated by the learner's cursor.
 
         eval_pipeline = PromptPipeline(
             eval_prompts if eval_prompts is not None else prompts,
@@ -122,6 +131,16 @@ def train(
 
     else:
         raise ValueError("Either reward_fn or dataset must be given (reference: trlx/trlx.py:89-90)")
+
+    if getattr(model, "fleet_role", None) == "rollout":
+        # Disaggregated rollout job: run the persistent worker loop INSTEAD
+        # of learn() — generate under the staleness gate, stream episodes,
+        # follow the versioned weight broadcast, exit on the coordinated
+        # abort marker (trlx_tpu/fleet/runner.py).
+        from trlx_tpu.fleet import run_rollout_worker
+
+        run_rollout_worker(model, orch)
+        return model
 
     model.learn()
     return model

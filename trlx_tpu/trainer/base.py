@@ -1055,6 +1055,11 @@ class JaxBaseTrainer(BaseRLTrainer):
                 # process starts clean.
                 obs_numerics.shutdown()
                 self._graftnum = None
+            if self.heartbeat is not None:
+                # Join the writer thread (a leaked trlx-heartbeat would fail
+                # the drills' thread-cleanliness assertions); stop() flushes
+                # one final record so post-mortem readers see the exit state.
+                self.heartbeat.stop()
             if self._metrics_exporter is not None:
                 # Exporter last: it only serves snapshots, so scrapers get
                 # the final gauge state right up to teardown.

@@ -17,6 +17,7 @@ import optax
 
 from trlx_tpu.data import PackedPPOBatch, PPORLBatch
 from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.fleet import FleetDegradedExit, validate_fleet_config
 from trlx_tpu.models.heads import LMWithValueHead, extract_branch_params
 from trlx_tpu.ops.fused_logprob import fused_logprob_eligible
 from trlx_tpu.ops.generate import make_generate_fn
@@ -81,13 +82,25 @@ class PPOTrainer(JaxBaseTrainer):
         super().__init__(config, **kwargs)
         m = config.method
 
+        # Disaggregated rollout/learner fleet (trlx_tpu/fleet), validated at
+        # CONSTRUCTION: stray fleet knobs (fleet_disaggregate off but
+        # train.fleet_* set), a bad role, a multi-controller world, or a
+        # fleet+rollout_overlap combination all fail HERE with a config
+        # ValueError — never as a mid-run raise. None = fleet off.
+        self.fleet_role = validate_fleet_config(config)
+
         # Pipelined rollout/train overlap (trlx_tpu/pipeline/overlap.py).
         # overlap_rollouts turns the machinery on: background reward scoring,
         # device batch prefetch, and the double-buffered rollout producer.
         # max_staleness > 0 additionally lets the producer generate off a
         # boundary param snapshot while training runs — bounded off-policy.
+        # In fleet mode max_staleness instead bounds the CROSS-JOB episode
+        # stream (trlx_tpu/fleet/runner.py) and the in-process machinery
+        # stays off.
         self.max_staleness = max(0, int(getattr(m, "max_staleness", 0) or 0))
-        self.overlap_rollouts = bool(getattr(m, "rollout_overlap", False)) or self.max_staleness > 0
+        self.overlap_rollouts = (
+            bool(getattr(m, "rollout_overlap", False)) or self.max_staleness > 0
+        ) and self.fleet_role is None
         # Packed train batches (pipeline.ppo_pipeline.pack_ppo_batch) +
         # train-throughput metering for the phase window (satellite of the
         # fused-logprob head work; see make_ppo_train_step).
@@ -97,26 +110,39 @@ class PPOTrainer(JaxBaseTrainer):
         self._pack_rows_multiple = int(np.prod([self.mesh.shape[a] for a in DATA_AXES]))
         self._window_tokens = []
         self._window_fill = []
-        if self.max_staleness > 0 and jax.process_count() > 1:
+        if self.fleet_role is None and self.max_staleness > 0 and jax.process_count() > 1:
             # Two threads dispatching device programs concurrently cannot
             # guarantee the same collective launch order on every host — the
             # classic multi-controller deadlock. Staleness-0 overlap is safe
             # (the producer only runs while the main thread is parked in
-            # next_store, and its device work is collective-free).
+            # next_store, and its device work is collective-free). The fleet
+            # path dodges this entirely: each role is its OWN world.
             raise ValueError(
                 "method.max_staleness > 0 is single-host only: concurrent "
                 "rollout generation and training would interleave device "
                 "program dispatch differently across hosts. Use "
-                "method.rollout_overlap (staleness 0) on multi-host pods."
+                "method.rollout_overlap (staleness 0) on multi-host pods, or "
+                "disaggregate generation onto a dedicated rollout job "
+                "(method.fleet_disaggregate, trlx_tpu/fleet) — there "
+                "max_staleness bounds the cross-job episode stream instead."
             )
         self._phase_timer = PhaseTimer()
         self._rollout_producer = None
         self._last_exp_stats = None
+        # Fleet learner/colocated feed (built by _fleet_bootstrap) and the
+        # degraded-exit latch (set when the feed raises FleetDegradedExit).
+        self._fleet_feed = None
+        self._fleet_stopped = False
 
         # record_staleness is decided ONCE here so iteration 0's store (the
         # pre-learn fill) and every producer-built store share one column
         # layout — and therefore one batch pytree and one train-step trace.
-        self.store = PPORolloutStorage(self.pad_token_id, record_staleness=self.overlap_rollouts)
+        # Fleet stores always carry the column: realized staleness is
+        # stamped at consume time (trlx_tpu/fleet/runner.py).
+        self.store = PPORolloutStorage(
+            self.pad_token_id,
+            record_staleness=self.overlap_rollouts or self.fleet_role is not None,
+        )
 
         if m.target is not None:
             self.kl_ctl = AdaptiveKLController(m.init_kl_coef, m.target, m.horizon)
@@ -278,7 +304,10 @@ class PPOTrainer(JaxBaseTrainer):
                     "method.rollout_engine is single-host only: the engine's "
                     "host-side slot manager admits prompts data-dependently, "
                     "so multi-controller hosts would dispatch different "
-                    "device programs. Use the chunked rollout path on pods."
+                    "device programs. Use the chunked rollout path on pods, "
+                    "or give the engine its own single-controller rollout "
+                    "job (method.fleet_disaggregate, trlx_tpu/fleet) — "
+                    "there it runs persistently on the rollout side."
                 )
             if self._qw is not None:
                 raise ValueError(
@@ -783,7 +812,20 @@ class PPOTrainer(JaxBaseTrainer):
         (reference: trlx/model/accelerate_ppo_model.py:157-161)."""
         self._flush_kl_updates()  # rollout rewards consume kl_ctl.value
         self._refresh_decode_weights()  # sampler follows the updated policy
-        if self._rollout_producer is None:
+        if self._fleet_feed is not None:
+            # Disaggregated/colocated fleet: publish the post-train weights
+            # (versioned broadcast), then consume the next stream batch.
+            # A FleetDegradedExit is the coordinated abort: checkpoint the
+            # rollback point FIRST (with the degraded /healthz state still
+            # exported), then unwind — learn() treats it as a clean stop.
+            try:
+                self._fleet_feed.consume_done()
+                self.store = self._fleet_feed.next_store()
+            except FleetDegradedExit:
+                self._fleet_stopped = True
+                self.save()
+                raise
+        elif self._rollout_producer is None:
             # Serial schedule: generate the next iteration's experience
             # inline, into the (cleared) long-lived store.
             self.store.clear_history()
@@ -872,6 +914,33 @@ class PPOTrainer(JaxBaseTrainer):
         if self._metrics_exporter is not None:
             self._metrics_exporter.update(stats, step=self.iter_count)
 
+    def learn(self):
+        """Fleet-aware learn: a FleetDegradedExit unwinding out of the loop
+        is a CLEAN stop, not a crash — the feed drained the in-flight
+        episodes, post_epoch_callback saved the rollback checkpoint, and
+        the base finally-teardown (which runs before this except) shut the
+        feed down with the coordinated abort marker."""
+        try:
+            return super().learn()
+        except FleetDegradedExit as e:
+            print(f"[fleet] learner stopped cleanly: {e}", flush=True)
+            return None
+
+    def _fleet_bootstrap(self):
+        """Learner/colocated fleet roles: iteration 0's store arrives
+        through the episode stream — trainer/api.py calls this in place of
+        the direct ``make_experience`` fill. Publishes the v0 weights first
+        so a disaggregated worker's staleness gate can open."""
+        from trlx_tpu.fleet import FleetLearnerFeed
+
+        if getattr(self, "_resumed", False):
+            # The feed tags weight versions with iter_count; a resumed
+            # learner must publish its RESTORED step, not 0 (learn() derives
+            # the same value later).
+            self.iter_count = int(jax.device_get(self.state.step))
+        self._fleet_feed = FleetLearnerFeed(self, getattr(self, "orch", None))
+        self.store = self._fleet_feed.bootstrap()
+
     def prepare_learning(self):
         """(reference: trlx/model/accelerate_ppo_model.py:167-184)"""
         self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
@@ -916,6 +985,19 @@ class PPOTrainer(JaxBaseTrainer):
     def _shutdown_experience_pipeline(self):
         """learn()'s finally: stop the producer before the run tears down
         (also on the preemption/early-return paths)."""
+        feed = self._fleet_feed
+        if feed is not None:
+            self._fleet_feed = None
+            # Preemption must NOT write the abort marker: this learner will
+            # resume into the same fleet_dir and the worker (alive the whole
+            # time) keeps serving it. Every other exit coordinates shutdown.
+            if getattr(self, "_preempted", False):
+                reason = "preempted"
+            elif self._fleet_stopped:
+                reason = "degraded"
+            else:
+                reason = "complete"
+            feed.shutdown(reason=reason)
         producer = self._rollout_producer
         if producer is not None:
             self._rollout_producer = None
