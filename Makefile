@@ -50,7 +50,10 @@ test-multihost:
 # slow_host drill (merged clock-aligned trace, skew table naming the
 # laggard, live fleet gauges) and hang drill (cross-host incident bundle),
 # plus the disaggregated rollout/learner drills (host kill + preemption +
-# resume, broadcast timeout, stream stall, 2-process parity; RUNBOOK §16).
+# resume, broadcast timeout, stream stall, 2-process parity; RUNBOOK §16),
+# plus the in-flight weight-update drills (torn push rejection, switch-storm
+# coalescing, 2-process engine schedule verify + skew, mid-decode host kill
+# with slot-state forensics, staleness-0 bitwise parity; RUNBOOK §17).
 # Set TRLX_TPU_DRILL_ARTIFACTS=<dir> to keep the merged trace, report
 # section, episode-stream index, broadcast log and fleet event log (the CI
 # job uploads them). Non-blocking CI job — jax.distributed caveats apply to

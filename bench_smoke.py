@@ -449,6 +449,13 @@ def main():
         manifest.heartbeat("probe", candidate=name)
         result[name] = probe()
         manifest.partial(result)
+    # An engine speedup number is only meaningful NEXT TO the occupancy it
+    # was measured at (a low-occupancy run can "beat" a static batch that
+    # padding starved) — the recorded artifact must keep the pair together.
+    eng = result["decode_engine"]
+    assert {"speedup", "slot_occupancy"} <= set(eng), (
+        f"decode_engine record must pair speedup with slot_occupancy: {eng}"
+    )
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:
         json.dump(result, f, indent=1)

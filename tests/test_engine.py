@@ -285,6 +285,163 @@ def test_engine_straggler_accounting_under_early_exit():
     engine.shutdown()
 
 
+# ----------------------------------------------- in-flight weight updates
+
+
+def _drain(engine):
+    episodes = []
+    for _ in range(200):
+        episodes.extend(engine.step())
+        if engine.idle:
+            break
+    return episodes
+
+
+def test_mid_decode_update_splits_episodes_at_the_sync_boundary():
+    """THE in-flight acceptance test: update_weights between sync points —
+    slots mid-decode, no drain, no abort — is adopted at the NEXT
+    steps_per_sync boundary, and every harvested Episode carries the exact
+    per-token split. steps_per_sync=3 and max_new_tokens=6 with no eos pin
+    the arithmetic: one step() generates exactly 3 tokens, so a push after
+    the first step must split every episode [(v1, 3), (v2, 3)]. Pushing the
+    SAME params under a new version number also proves the swap itself is
+    token-neutral: the decode output is unchanged vs an uninterrupted run."""
+    model, params, _, _ = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=6, do_sample=False, eos_token_id=None, pad_token_id=0)
+    prompts = np.random.default_rng(5).integers(2, 23, size=(2, 4)).astype(np.int32)
+    pmask = np.ones((2, 4), np.int32)
+
+    ref_engine = RolloutEngine(
+        model, gcfg, n_slots=2, prompt_width=4, prefill_batch=2,
+        steps_per_sync=3, rng=jax.random.PRNGKey(2),
+    )
+    ref_engine.update_weights(params, version=1)
+    ref_engine.submit(prompts, pmask)
+    ref = {tuple(e.prompt_ids.tolist()): e for e in _drain(ref_engine)}
+    ref_engine.shutdown()
+
+    engine = RolloutEngine(
+        model, gcfg, n_slots=2, prompt_width=4, prefill_batch=2,
+        steps_per_sync=3, rng=jax.random.PRNGKey(2),
+    )
+    engine.update_weights(params, version=1)
+    engine.submit(prompts, pmask)
+    eps = engine.step()
+    assert eps == []  # 3 of 6 tokens decoded: nothing finished yet
+    # slots are mid-decode RIGHT NOW — push without draining or aborting
+    engine.update_weights(params, version=2)
+    states = engine.slot_states()
+    assert [s["n_gen"] for s in states] == [3, 3]  # positions from the sync
+    episodes = _drain(engine)
+    assert len(episodes) == 2
+    for ep in episodes:
+        assert ep.version_spans == [(1, 3), (2, 3)]
+        assert ep.weight_version == 2  # tagged with the LAST version
+        assert ep.decode_steps == 6
+        r = ref[tuple(ep.prompt_ids.tolist())]
+        np.testing.assert_array_equal(ep.response_ids, r.response_ids)
+        np.testing.assert_array_equal(ep.response_mask, r.response_mask)
+    stats = engine.stats(reset=False)
+    assert stats["engine/weight_switches"] == 1
+    assert stats["engine/switches_coalesced"] == 0
+    engine.shutdown()
+
+
+def test_push_storm_coalesces_to_latest_and_same_version_is_a_noop():
+    """version_switch_storm contract: N pushes between two sync points adopt
+    ONCE, at the latest version — the queue never forms. And re-pushing the
+    version the engine already holds records no switch at all (the
+    phase-boundary handoff path stays span-free and byte-identical)."""
+    model, params, _, _ = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=6, do_sample=False, eos_token_id=None, pad_token_id=0)
+    engine = RolloutEngine(
+        model, gcfg, n_slots=2, prompt_width=4, prefill_batch=2,
+        steps_per_sync=3, rng=jax.random.PRNGKey(2),
+    )
+    engine.update_weights(params, version=1)
+    engine.submit(np.full((2, 4), 3, np.int32), np.ones((2, 4), np.int32))
+    engine.step()
+    # the storm: three pushes before the next sync boundary
+    engine.update_weights(params, version=2)
+    engine.update_weights(params, version=3)
+    engine.update_weights(params, version=4)
+    episodes = _drain(engine)
+    assert all(ep.version_spans == [(1, 3), (4, 3)] for ep in episodes)
+    stats = engine.stats(reset=False)
+    assert stats["engine/weight_switches"] == 1  # one adoption, not three
+    assert stats["engine/switches_coalesced"] == 2  # v2 and v3 never ran
+
+    # same-version re-push mid-decode: staged, adopted, but NO switch
+    engine.submit(np.full((2, 4), 5, np.int32), np.ones((2, 4), np.int32))
+    engine.step()
+    engine.update_weights(params, version=4)
+    episodes = _drain(engine)
+    assert all(ep.version_spans == [(4, 6)] for ep in episodes)
+    assert engine.stats(reset=False)["engine/weight_switches"] == 1
+    engine.shutdown()
+
+
+def test_schedule_fingerprint_is_deterministic_and_order_sensitive():
+    """The slot-schedule crc: identical configs + identical submissions make
+    identical fingerprints (the multi-host lockstep invariant
+    verify_engine_schedule checks by allgather), and a reordered admission
+    stream makes a DIFFERENT one (so a desynced host cannot collide)."""
+    model, params, _, _ = _tiny_model()
+    (w6, m6), (w4, m4) = _mixed_prompts()
+    gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, eos_token_id=None, pad_token_id=0)
+
+    def run(order):
+        engine = RolloutEngine(
+            model, gcfg, n_slots=2, prompt_width=6, prefill_batch=2,
+            steps_per_sync=2, rng=jax.random.PRNGKey(2),
+        )
+        engine.update_weights(params, version=1)
+        for ids, msk in order:
+            engine.submit(ids, msk)
+        _drain(engine)
+        crc = engine.schedule_fingerprint()
+        engine.shutdown()
+        return crc
+
+    a = run([(w6, m6), (w4, m4)])
+    b = run([(w6, m6), (w4, m4)])
+    c = run([(w4, m4), (w6, m6)])
+    assert a == b
+    assert a != c
+    assert 0 <= a <= 0xFFFFFFFF
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_engine_int8_decode_parity(kv_quant):
+    """Satellite: the engine decodes with the int8 weight copies (the qw
+    collection riding in the update_weights variables) token-for-token
+    identically to whole-batch make_generate_fn decode with the SAME
+    variables — the engine adds no numeric skew on top of W8A16 itself."""
+    from trlx_tpu.models.lm import quantize_weights
+
+    model, params, _, _ = _tiny_model(kv_cache_quant=kv_quant)
+    variables = {"params": params["params"], "qw": quantize_weights(params["params"])}
+    (w6, m6), (w4, m4) = _mixed_prompts()
+    gcfg = GenerateConfig(max_new_tokens=6, do_sample=False, eos_token_id=None, pad_token_id=0)
+    ref = _reference_episodes(model, variables, gcfg, [(w6, m6), (w4, m4)])
+
+    engine = RolloutEngine(
+        model, gcfg, n_slots=3, prompt_width=6, prefill_batch=3,
+        steps_per_sync=2, rng=jax.random.PRNGKey(2),
+    )
+    engine.update_weights(variables, version=1)
+    engine.submit(w6, m6)
+    engine.submit(w4, m4)
+    episodes = _drain(engine)
+    assert len(episodes) == 6
+    for ep in episodes:
+        key = (tuple(ep.prompt_ids.tolist()), tuple(ep.prompt_mask.tolist()))
+        rtoks, rmask = ref[key]
+        np.testing.assert_array_equal(ep.response_ids, rtoks)
+        np.testing.assert_array_equal(ep.response_mask, rmask)
+    engine.shutdown()
+
+
 def test_engine_requires_weight_handoff_and_bounds_prompt_width():
     model, params, _, _ = _tiny_model()
     gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, pad_token_id=0)
@@ -422,7 +579,11 @@ def test_ppo_with_rollout_engine_trains_and_tears_down(task, tmp_path, monkeypat
     assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
 
 
-def test_rollout_engine_rejects_incompatible_configs(task):
+def test_rollout_engine_config_validation(task):
+    """The engine+decode_weight_quant guard is LIFTED (the unfused scoring
+    delta is bounded by test_engine_int8_decode_parity): construction
+    succeeds and both the engine and the int8 copies are armed. Without the
+    engine, int8 decode still demands the fused-stats path."""
     from trlx_tpu.trainer.ppo import PPOTrainer
 
     _, logit_mask, _, _ = task
@@ -432,6 +593,18 @@ def test_rollout_engine_rejects_incompatible_configs(task):
     config.method.chunk_size = 16
     config.method.rollout_engine = True
     config.model.decode_weight_quant = True
+    trainer = PPOTrainer(config, logit_mask=logit_mask)
+    assert trainer.rollout_engine_enabled and trainer._qw is not None
+    # the engine's versioned handoff payload carries the int8 copies too
+    assert "qw" in trainer.rollout_engine_variables()
+    trainer._shutdown_experience_pipeline()
+
+    config = base_config("ppo", 15, 8)
+    config.train.batch_size = 16
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    config.model.decode_weight_quant = True
+    config.method.fused_rollout_stats = False  # no fused path, no engine
     with pytest.raises(ValueError, match="decode_weight_quant"):
         PPOTrainer(config, logit_mask=logit_mask)
 

@@ -59,10 +59,10 @@ def task():
 # ----------------------------------------------------- colocated parity (fast)
 
 
-def _run_ppo(task, ckpt_dir, fleet=False, **overrides):
+def _run_ppo(task, ckpt_dir, fleet=False, steps=8, **overrides):
     _, logit_mask, metric_fn, reward_fn = task
     config = base_config("ppo", 15, 8)
-    config.train.total_steps = 8
+    config.train.total_steps = steps
     config.train.epochs = 4
     config.train.batch_size = 16
     config.train.eval_interval = 100
@@ -129,6 +129,53 @@ def test_colocated_staleness0_matches_serial_bitwise(task, tmp_path, monkeypatch
         assert json.load(f)["reason"] == "complete"
 
 
+def test_colocated_inflight_knob_staleness0_is_bitwise_with_span_records(
+    task, tmp_path, monkeypatch
+):
+    """In-flight weight updates, acceptance identity (PR 17): at staleness 0
+    the learner only publishes AFTER consuming a batch and the worker cannot
+    start the next one until that publish — so no push can ever land
+    mid-phase, and flipping method.fleet_inflight_weights must change the
+    loss trajectory by NOTHING (bitwise). What the knob DOES change is the
+    stream index: knob-off records are the PR 16 shape (no spans key),
+    knob-on records carry exactly one span naming their own version, with
+    zero mixed-version tokens at consume time."""
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, SANITIZE)
+    engine = dict(max_staleness=0, rollout_engine=True, engine_steps_per_sync=2)
+    try:
+        _, off = _run_ppo(task, tmp_path / "off", fleet=True, steps=4, **engine)
+        _, on = _run_ppo(
+            task, tmp_path / "on", fleet=True, steps=4,
+            fleet_inflight_weights=True, **engine,
+        )
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
+        sanitize.clear_races()
+
+    losses_off = [r["loss"] for r in off if "loss" in r]
+    losses_on = [r["loss"] for r in on if "loss" in r]
+    assert len(losses_off) == 4
+    assert losses_on == losses_off
+
+    stream_off = read_jsonl_or_empty(os.path.join(str(tmp_path / "off") + "_fleet", "stream.jsonl"))
+    stream_on = read_jsonl_or_empty(os.path.join(str(tmp_path / "on") + "_fleet", "stream.jsonl"))
+    assert stream_off and all("version_spans" not in r for r in stream_off)
+    assert stream_on
+    for r in stream_on:
+        assert r["version_spans"] == [[r["weight_version"], r["version_spans"][0][1]]]
+        assert r["version_spans"][0][1] > 0
+    # Token-granularity staleness: every consumed batch was single-version,
+    # so the mixed-token count is identically zero.
+    events = read_jsonl_or_empty(os.path.join(str(tmp_path / "on") + "_fleet", "fleet_events.jsonl"))
+    consumed = [e for e in events if e["event"] == "episode_consumed"]
+    assert consumed and all(e["mixed_version_tokens"] == 0 for e in consumed)
+    assert all(e["staleness"] == 0 for e in consumed)
+
+
 # ------------------------------------------------------- 2-process drills
 
 pytest_slow = pytest.mark.slow
@@ -163,9 +210,14 @@ config.train.checkpoint_dir = ckpt
 config.train.resume_from_checkpoint = bool(int(os.environ.get("RESUME", "0")))
 config.method.num_rollouts = 16
 config.method.chunk_size = 16
+# Continuous-batching engine + in-flight weight adoption (PR 17 drills).
+if int(os.environ.get("ENGINE", "0")):
+    config.method.rollout_engine = True
+    config.method.engine_steps_per_sync = int(os.environ.get("ENGINE_SYNC", "2"))
 if role != "serial":
     config.method.fleet_disaggregate = True
     config.method.max_staleness = S
+    config.method.fleet_inflight_weights = bool(int(os.environ.get("INFLIGHT", "0")))
     config.train.fleet_dir = fleet_dir
     # Drill-scale timing: seconds, not the production minutes.
     config.train.heartbeat_interval = 0.2
@@ -279,7 +331,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _export_artifacts(fleet_dir, logs):
+def _export_artifacts(fleet_dir, logs, extra=None):
     dest = os.environ.get("TRLX_TPU_DRILL_ARTIFACTS")
     if not dest:
         return
@@ -288,6 +340,12 @@ def _export_artifacts(fleet_dir, logs):
         src = os.path.join(str(fleet_dir), name)
         if os.path.exists(src):
             shutil.copy(src, os.path.join(dest, name))
+    # Span/lineage artifacts (in-flight weight updates): per-role lineage
+    # and metrics files named by their source dir so uploads don't collide.
+    for src in extra or []:
+        if os.path.exists(src):
+            tag = os.path.basename(os.path.dirname(src))
+            shutil.copy(src, os.path.join(dest, f"{tag}_{os.path.basename(src)}"))
     for name, text in logs.items():
         with open(os.path.join(dest, name), "w") as f:
             f.write(text)
@@ -518,3 +576,176 @@ def test_two_process_staleness0_matches_serial_bitwise(tmp_path):
             worker.kill()
             worker.communicate()
         _export_artifacts(fleet_dir, logs)
+
+
+# ------------------------------------- in-flight weight update drills (PR 17)
+
+_ENGINE_ENV = {"ENGINE": "1", "ENGINE_SYNC": "2", "INFLIGHT": "1"}
+
+
+def _worker_metrics(ckpt):
+    path = os.path.join(str(ckpt), "metrics.jsonl")
+    return read_jsonl_or_empty(path)
+
+
+@pytest.mark.slow
+def test_fleet_drill_weight_push_torn_rejects_and_holds_old_version(tmp_path):
+    """weight_push_torn@2 on the learner: the pointer flips to ordinal 2 but
+    the snapshot file is truncated. The in-flight poller (and the boundary
+    path) must REJECT the torn load — weights_torn event naming the ordinal,
+    decoding continues on the held version — and pick up the next intact
+    ordinal. Nobody crashes, nobody hangs; every streamed version is a
+    published one."""
+    fleet_dir = tmp_path / "fleet"
+    env = {"TOTAL": "8", "EPOCHS": "4", **_ENGINE_ENV}
+    worker = _launch_role(tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 2, env)
+    logs = {}
+    try:
+        learner = _launch_role(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 2,
+            {**env, "TRLX_TPU_FAULTS": "weight_push_torn@2"},
+        )
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w = logs["worker.log"] = _communicate(worker, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert worker.returncode == 0, f"worker failed:\n{out_w[-4000:]}"
+
+        broadcast = read_jsonl_or_empty(os.path.join(str(fleet_dir), "broadcast.jsonl"))
+        torn = [r for r in broadcast if r["status"] == "injected_torn"]
+        assert [r["ordinal"] for r in torn] == [2]
+
+        events = _events(fleet_dir)
+        rejected = [e for e in events if e["event"] == "weights_torn"]
+        assert rejected, "torn snapshot was never observed/rejected by the worker"
+        assert all(e["ordinal"] == 2 for e in rejected)
+        assert all(e["held"] < 2 for e in rejected)
+        # The worker moved PAST the torn ordinal onto a later intact one.
+        adopted = [
+            e["ordinal"] for e in events
+            if e["event"] in ("weights_adopted_inflight", "weights_fetched")
+        ]
+        assert adopted and max(adopted) >= 3
+
+        # Lineage stayed intact: the torn version never decoded a token.
+        stream = read_jsonl_or_empty(os.path.join(str(fleet_dir), "stream.jsonl"))
+        published = {r["version"] for r in broadcast if r["status"] == "published"}
+        assert stream and {r["weight_version"] for r in stream} <= published
+        for r in stream:
+            for v, k in r.get("version_spans") or []:
+                assert v in published and k > 0
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w, "worker")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs, extra=[
+            os.path.join(str(tmp_path / "ckpt_w"), "metrics.jsonl"),
+            os.path.join(str(tmp_path / "ckpt_l"), "lineage.jsonl"),
+        ])
+
+
+@pytest.mark.slow
+def test_fleet_drill_version_switch_storm_coalesces_never_queues(tmp_path):
+    """version_switch_storm@3 on the worker: for a window of syncs the
+    poller re-pushes its held latest every sync. The engine must coalesce —
+    same-version re-pushes record NO switch, a burst between two syncs keeps
+    only the newest — so the switch count stays bounded by the number of
+    distinct versions actually adopted, spans stay strictly
+    version-increasing, and the run completes."""
+    fleet_dir = tmp_path / "fleet"
+    env = {"TOTAL": "8", "EPOCHS": "4", **_ENGINE_ENV}
+    worker = _launch_role(
+        tmp_path, "rollout", tmp_path / "ckpt_w", fleet_dir, 2,
+        {**env, "TRLX_TPU_FAULTS": "version_switch_storm@3",
+         "TRLX_TPU_SWITCH_STORM_PUSHES": "6"},
+    )
+    logs = {}
+    try:
+        learner = _launch_role(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 2, env)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w = logs["worker.log"] = _communicate(worker, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert worker.returncode == 0, f"worker failed:\n{out_w[-4000:]}"
+
+        # Switches bounded by distinct mid-phase adoptions: the 6 storm
+        # re-pushes of the held version must not have recorded any.
+        events = _events(fleet_dir)
+        adoptions = [e for e in events if e["event"] == "weights_adopted_inflight"]
+        metrics = _worker_metrics(tmp_path / "ckpt_w")
+        switches = sum(int(r.get("engine/weight_switches", 0)) for r in metrics)
+        assert any("engine/weight_switches" in r for r in metrics)
+        assert switches <= len(adoptions)
+
+        # Per-record spans stay minimal: strictly increasing versions, no
+        # same-version split from the storm.
+        stream = read_jsonl_or_empty(os.path.join(str(fleet_dir), "stream.jsonl"))
+        assert stream
+        for r in stream:
+            spans = r.get("version_spans") or []
+            versions = [v for v, _ in spans]
+            assert versions == sorted(set(versions)), f"span thrash in {r}"
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w, "worker")
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.communicate()
+        _export_artifacts(fleet_dir, logs, extra=[
+            os.path.join(str(tmp_path / "ckpt_w"), "metrics.jsonl"),
+            os.path.join(str(tmp_path / "ckpt_l"), "lineage.jsonl"),
+        ])
+
+
+@pytest.mark.slow
+def test_two_process_inflight_knob_staleness0_matches_knob_off_bitwise(tmp_path):
+    """The 2-process form of the in-flight acceptance identity: with real
+    role processes at max_staleness=0, the publish-before-advance schedule
+    means no weight push can land mid-phase — so the engine run with
+    method.fleet_inflight_weights ON reproduces the knob-OFF learner loss
+    trajectory bitwise, while its stream records carry single-version
+    spans."""
+    def leg(tag, inflight):
+        fleet_dir = tmp_path / f"fleet_{tag}"
+        env = {"TOTAL": "8", "EPOCHS": "4", "ENGINE": "1", "ENGINE_SYNC": "2",
+               "INFLIGHT": "1" if inflight else "0"}
+        worker = _launch_role(
+            tmp_path, "rollout", tmp_path / f"ckpt_w_{tag}", fleet_dir, 0, env
+        )
+        logs = {}
+        try:
+            learner = _launch_role(
+                tmp_path, "learner", tmp_path / f"ckpt_l_{tag}", fleet_dir, 0, env
+            )
+            out_l = logs["learner.log"] = _communicate(learner)
+            out_w = logs["worker.log"] = _communicate(worker, timeout=120)
+            assert learner.returncode == 0, f"{tag} learner failed:\n{out_l[-4000:]}"
+            assert worker.returncode == 0, f"{tag} worker failed:\n{out_w[-4000:]}"
+            _assert_clean_threads(out_l, f"{tag} learner")
+            _assert_clean_threads(out_w, f"{tag} worker")
+            line = next(l for l in out_l.splitlines() if l.startswith("LOSSES "))
+            return json.loads(line[len("LOSSES "):]), fleet_dir
+        finally:
+            if worker.poll() is None:
+                worker.kill()
+                worker.communicate()
+            _export_artifacts(fleet_dir, logs, extra=[
+                os.path.join(str(tmp_path / f"ckpt_l_{tag}"), "lineage.jsonl"),
+            ])
+
+    losses_off, dir_off = leg("off", inflight=False)
+    losses_on, dir_on = leg("on", inflight=True)
+    assert len(losses_off) == 8
+    assert losses_on == losses_off
+
+    stream_off = read_jsonl_or_empty(os.path.join(str(dir_off), "stream.jsonl"))
+    stream_on = read_jsonl_or_empty(os.path.join(str(dir_on), "stream.jsonl"))
+    assert stream_off and all("version_spans" not in r for r in stream_off)
+    assert stream_on and all(
+        len(r["version_spans"]) == 1
+        and r["version_spans"][0][0] == r["weight_version"]
+        for r in stream_on
+    )
+    consumed = [e for e in _events(dir_on) if e["event"] == "episode_consumed"]
+    assert consumed and all(e["staleness"] == 0 for e in consumed)
+    assert all(e["mixed_version_tokens"] == 0 for e in consumed)

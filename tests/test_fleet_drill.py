@@ -40,7 +40,7 @@ _DRILL_WORKER = r"""
 import json, os, sys, threading, time
 import urllib.request
 
-mode = sys.argv[1]            # "slow" | "hang"
+mode = sys.argv[1]            # "slow" | "hang" | "engine" | "engine_kill"
 pid = int(sys.argv[2])
 port = sys.argv[3]
 ckpt = sys.argv[4]
@@ -98,7 +98,13 @@ def make_config(total_steps):
         config.train.log_interval = 10**6
     return config
 
-prompts = [[(i % 14) + 1] for i in range(8 * pid, 8 * (pid + 1))]
+if mode in ("engine", "engine_kill"):
+    # Multi-process ENGINE contract (engine/rollout_engine.py): every host
+    # submits the SAME global prompt set — identical slot schedules by
+    # construction, verified per phase by the slot-schedule crc.
+    prompts = [[(i % 14) + 1] for i in range(8)]
+else:
+    prompts = [[(i % 14) + 1] for i in range(8 * pid, 8 * (pid + 1))]
 eval_prompts = [[1], [2]]
 
 scrapes_stop = threading.Event()
@@ -154,6 +160,29 @@ elif mode == "hang":
         metric_fn=metric_fn, config=make_config(10), logit_mask=logit_mask,
     )
     print(f"fleet hang proc {pid} FINISHED WITHOUT ABORT")
+
+elif mode in ("engine", "engine_kill"):
+    # 2-process continuous-batching engine run: replicated slot state
+    # (_globalize), identical schedules cross-checked per phase by
+    # verify_engine_schedule under the engine/schedule_verify guard.
+    # - clean leg: completes → proves the per-phase crc check passes when
+    #   schedules really match;
+    # - TRLX_TPU_ENGINE_SCHEDULE_SKEW on proc 1: the phase-end check raises
+    #   HostDesync NAMING host 1 on every host — desync by name, not hang;
+    # - engine_kill: proc 1 carries mid_decode_host_kill@2 and dies abruptly
+    #   between decode syncs with slots live; proc 0 blocks on the dead peer
+    #   at its next guarded cross-host sync and aborts exit-117 with an
+    #   incident bundle carrying its slot states — this FINISHED print is
+    #   only reachable on proc 0 if detection FAILED.
+    config = make_config(3 if mode == "engine" else 10)
+    config.method.rollout_engine = True
+    config.method.engine_steps_per_sync = 2
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=eval_prompts,
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+    print(f"fleet {mode} proc {pid} DONE" if mode == "engine"
+          else f"fleet {mode} proc {pid} FINISHED WITHOUT ABORT")
 """
 
 
@@ -163,7 +192,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch(tmp_path, mode, faults_by_pid, metrics_port=0):
+def _launch(tmp_path, mode, faults_by_pid, metrics_port=0, env_by_pid=None):
     port = _free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "fleet_drill_worker.py"
@@ -174,6 +203,7 @@ def _launch(tmp_path, mode, faults_by_pid, metrics_port=0):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.pop("TRLX_TPU_FAULTS", None)
+        env.pop("TRLX_TPU_ENGINE_SCHEDULE_SKEW", None)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = repo
         env["TRLX_REPO"] = repo
@@ -183,6 +213,7 @@ def _launch(tmp_path, mode, faults_by_pid, metrics_port=0):
             env["TRLX_TPU_METRICS_PORT"] = str(metrics_port)
         if pid in faults_by_pid:
             env["TRLX_TPU_FAULTS"] = faults_by_pid[pid]
+        env.update((env_by_pid or {}).get(pid, {}))
         procs.append(
             subprocess.Popen(
                 [sys.executable, str(script), mode, str(pid), str(port), ckpt],
@@ -222,6 +253,21 @@ def _export_artifacts(ckpt, extra=()):
                 shutil.copytree(src, os.path.join(dest, name), dirs_exist_ok=True)
             else:
                 shutil.copy(src, os.path.join(dest, name))
+
+
+def _communicate(procs):
+    """Collect both drill processes' merged output, skipping (not failing)
+    when the environment can't finish a 2-process run in the budget."""
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out.decode(errors="replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("2-process drill did not complete in this environment")
+    return outs
 
 
 def test_fleet_drill_slow_host_attribution_and_live_gauges(tmp_path):
@@ -351,5 +397,112 @@ def test_fleet_drill_hang_leaves_cross_host_incident_bundle(tmp_path):
             assert os.path.getsize(tail) > 0
             with open(os.path.join(bundle, f"host{host}", "heartbeat.json")) as f:
                 json.load(f)  # well-formed forensics payload
+    finally:
+        _export_artifacts(ckpt, extra=("incidents",))
+
+
+# --------------------------------------- multi-host engine drills (PR 17)
+
+
+def test_fleet_drill_engine_two_process_clean(tmp_path):
+    """Drill C (clean leg): the continuous-batching engine runs at
+    process_count()==2 — replicated slot state, identical per-host
+    admission/harvest schedules — and the per-phase slot-schedule crc check
+    passes on every phase. Both procs finish cleanly, no incident bundle."""
+    procs, ckpt = _launch(tmp_path, "engine", {})
+    outs = _communicate(procs)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        _skip_if_distributed_unavailable(p, out)
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+        assert f"fleet engine proc {pid} DONE" in out
+    # A clean run must not leave collective-timeout forensics behind.
+    incidents = os.path.join(ckpt, "incidents")
+    bundles = [
+        d
+        for d in (os.listdir(incidents) if os.path.isdir(incidents) else [])
+        if os.path.exists(os.path.join(incidents, d, "fleet_incident.json"))
+    ]
+    assert not bundles, f"clean engine drill left incident bundles: {bundles}"
+
+
+def test_fleet_drill_engine_schedule_skew_is_named_desync(tmp_path):
+    """Drill C (skew leg): host 1 reports a skewed slot-schedule crc
+    (TRLX_TPU_ENGINE_SCHEDULE_SKEW — the injection signature of a desynced
+    slot manager) → the phase-end check raises the identical HostDesync
+    NAMING host 1 on BOTH hosts. Desync by name, never a hung collective."""
+    procs, _ = _launch(
+        tmp_path,
+        "engine",
+        {},
+        env_by_pid={1: {"TRLX_TPU_ENGINE_SCHEDULE_SKEW": "1"}},
+    )
+    outs = _communicate(procs)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        _skip_if_distributed_unavailable(p, out)
+        assert p.returncode != 0, (
+            f"proc {pid} should have aborted on HostDesync:\n{out[-4000:]}"
+        )
+        assert f"fleet engine proc {pid} DONE" not in out
+        # The coordinated abort names the skewed host and the component.
+        assert "engine slot-schedule check failed" in out, out[-4000:]
+        assert "host 1" in out
+        assert "slot schedule crc32" in out
+
+
+def test_fleet_drill_mid_decode_host_kill_exit117_with_slot_states(tmp_path):
+    """Drill D: host 1 dies abruptly (os._exit) between decode syncs with
+    slots mid-decode → host 0 hits its guarded cross-host engine sync, the
+    collective_guard converts the dead peer into exit 117, and the fleet
+    incident bundle names the wedged engine collective AND carries host 0's
+    per-slot states at abort time."""
+    procs, ckpt = _launch(tmp_path, "engine_kill", {1: "mid_decode_host_kill@2"})
+    try:
+        out0, _ = procs[0].communicate(timeout=900)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("2-process drill did not complete in this environment")
+    finally:
+        procs[1].kill()  # no-op when the fault already os._exit(1)'d it
+        procs[1].communicate()
+    out0 = out0.decode(errors="replace")
+    _skip_if_distributed_unavailable(procs[0], out0)
+    try:
+        assert procs[1].returncode == 1, (
+            f"proc 1 should have died via mid_decode_host_kill, "
+            f"got {procs[1].returncode}"
+        )
+        assert procs[0].returncode == EXIT_COLLECTIVE_TIMEOUT, (
+            f"expected exit {EXIT_COLLECTIVE_TIMEOUT}, "
+            f"got {procs[0].returncode}:\n{out0[-4000:]}"
+        )
+        assert "FINISHED WITHOUT ABORT" not in out0
+
+        incidents = os.path.join(ckpt, "incidents")
+        fleet_bundles = [
+            d
+            for d in (os.listdir(incidents) if os.path.isdir(incidents) else [])
+            if os.path.exists(os.path.join(incidents, d, "fleet_incident.json"))
+        ]
+        assert fleet_bundles, f"no fleet incident bundle under {incidents}"
+        bundle = os.path.join(incidents, fleet_bundles[0])
+        with open(os.path.join(bundle, "fleet_incident.json")) as f:
+            manifest = json.load(f)
+        assert manifest["reason"] == "collective_timeout"
+        assert manifest["collected_by"] == 0  # the surviving host collected
+        detail = manifest["detail"]
+        # The wedged collective is one of the engine's guarded syncs — both
+        # carry the engine's slot states in their forensics detail.
+        assert detail["collective"] in (
+            "engine/schedule_verify",
+            "engine/decode_sync",
+        ), detail
+        assert "slot_states" in detail, detail
+        assert isinstance(detail["slot_states"], list)
+        for slot in detail["slot_states"]:
+            assert "slot" in slot and "n_gen" in slot and "version" in slot, slot
+        # The survivor's own span tail made it into the bundle.
+        tail = os.path.join(bundle, "host0", "spans_tail.jsonl")
+        assert os.path.exists(tail) and os.path.getsize(tail) > 0
     finally:
         _export_artifacts(ckpt, extra=("incidents",))

@@ -361,3 +361,84 @@ def test_role_timeouts_resolve_documented_defaults():
     assert got["heartbeat_timeout"] == 9.0
     # fleet deadline falls back to the collective deadline before 60s.
     assert got["broadcast_deadline"] == 45.0
+
+
+# -------------------------------------- in-flight weight updates (PR 17)
+
+
+def test_cursor_torn_read_falls_back_to_last_indexed_seq(tmp_path):
+    """A PRESENT-but-garbage cursor must NOT read as 0 — a restarted
+    learner would silently re-train on every streamed batch. The fallback
+    is 1 + the last indexed seq (at-most-once); a MISSING cursor is a
+    fresh fleet and genuinely means 0."""
+    from trlx_tpu.fleet.runner import _read_cursor
+
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    assert _read_cursor(paths) == 0  # missing = fresh fleet
+    writer = EpisodeStreamWriter(paths)
+    for _ in range(3):
+        writer.append(_columns(), weight_version=0)
+    with open(paths.cursor, "w") as f:
+        f.write('{"consu')  # torn write mid-flight
+    assert _read_cursor(paths) == 3  # 1 + max indexed seq (2)
+    with open(paths.cursor, "w") as f:
+        json.dump({"consumed": 1}, f)
+    assert _read_cursor(paths) == 1  # intact cursor wins over the index
+
+
+def test_put_leaves_names_first_dtype_mismatched_leaf(tmp_path):
+    """Satellite: a same-shape dtype misconfig (f32 learner streaming to a
+    bf16 rollout world) must fail NAMING the first mismatched leaf path,
+    not with an anonymous byte-count skew."""
+    import jax.numpy as jnp
+
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    params = _params()
+    WeightPublisher(paths).publish(params, version=0)
+    sub = WeightSubscriber(paths)
+    leaves = sub.load(sub.latest())
+    # Same tree, same shapes — but "b" is bf16 here while the published
+    # snapshot's "b" is f32: half the bytes per element.
+    wrong = {"w": params["w"], "b": params["b"].astype(jnp.bfloat16)}
+    with pytest.raises(ValueError, match=r"leaf size mismatch at param leaf") as e:
+        put_leaves(wrong, leaves)
+    assert "'b'" in str(e.value)
+    assert "dtype mismatch" in str(e.value)
+
+
+def test_torn_publish_is_rejected_by_try_load_but_raises_from_load(tmp_path):
+    """weight_push_torn drill contract: the latest pointer names the torn
+    ordinal, ``try_load`` treats it as not-there (keep the held version),
+    plain ``load`` raises — and the previous intact ordinal still loads."""
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    params = _params()
+    pub = WeightPublisher(paths, fault_plan=FaultPlan.parse("weight_push_torn@1"))
+    pub.publish(params, version=0)
+    pub.publish(params, version=1)  # injected: pointer flips, file truncated
+    statuses = [r["status"] for r in read_jsonl_or_empty(paths.broadcast_log)]
+    assert statuses == ["published", "published", "injected_torn"]
+    sub = WeightSubscriber(paths)
+    latest = sub.latest()
+    assert latest["ordinal"] == 1  # the pointer DID flip before the tear
+    assert sub.try_load(latest) is None
+    with pytest.raises(Exception):
+        sub.load(latest)
+    intact = [r for r in pub.published() if r["ordinal"] == 0][0]
+    got = sub.try_load(intact)
+    assert got is not None and len(got) == 2
+
+
+def test_stream_index_records_version_spans_only_when_given(tmp_path):
+    """Index-record compatibility: no spans argument → the record is the
+    PR 16 shape (no key at all); spans given → normalized [[v, n], ...]."""
+    paths = FleetPaths(root=str(tmp_path)).ensure()
+    writer = EpisodeStreamWriter(paths)
+    writer.append(_columns(), weight_version=5)
+    writer.append(
+        _columns(), weight_version=7, version_spans=[(np.int64(6), 5), (7, 2)]
+    )
+    recs = read_jsonl_or_empty(paths.stream_index)
+    assert "version_spans" not in recs[0]
+    assert recs[1]["version_spans"] == [[6, 5], [7, 2]]
+    # json round-trip kept plain ints (np scalars normalized at append)
+    assert all(isinstance(v, int) for span in recs[1]["version_spans"] for v in span)

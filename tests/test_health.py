@@ -49,6 +49,7 @@ from trlx_tpu.observability.health import (  # noqa: E402
     HysteresisDetector,
     KLHealthDetector,
     LineageRecord,
+    MixedVersionDetector,
     RewardDriftDetector,
     RolloutSentinel,
     degenerate_rate,
@@ -221,6 +222,24 @@ def test_rollout_sentinel_degeneracy_drives_crit():
     assert d.severity({"trunc": 0.0, "degen": 0.9}) == 2
 
 
+def test_mixed_version_detector_fraction_bands():
+    """Token-granularity staleness watch (in-flight weight updates): the
+    fraction of a batch's tokens NOT at its freshest version drives the
+    severity — some mix is normal, a mostly-old batch is the problem."""
+    d = MixedVersionDetector(warn_frac=0.5, crit_frac=0.9, warn_streak=1, crit_streak=2)
+    assert d.severity({"mixed_tokens": 0.0, "total_tokens": 128.0}) == 0
+    assert d.severity({"mixed_tokens": 40.0, "total_tokens": 128.0}) == 0
+    assert d.severity({"mixed_tokens": 64.0, "total_tokens": 128.0}) == 1
+    assert d.severity({"mixed_tokens": 120.0, "total_tokens": 128.0}) == 2
+    assert d.frac == pytest.approx(120.0 / 128.0)
+    # An empty window (no tokens consumed) is OK, not a zero-division.
+    assert d.severity({"mixed_tokens": 0.0, "total_tokens": 0.0}) == 0
+    # Through the hysteresis machine: a single mostly-old batch only WARNs
+    # (crit needs a streak), sustained mix escalates.
+    assert d.observe({"mixed_tokens": 127.0, "total_tokens": 128.0}) == WARN
+    assert d.observe({"mixed_tokens": 127.0, "total_tokens": 128.0}) == CRIT
+
+
 # ----------------------------------------------------- lineage + monitor
 
 
@@ -232,6 +251,22 @@ def test_lineage_record_roundtrip():
     # extra keys from a newer writer are ignored, not fatal
     line = json.dumps({**json.loads(r.to_json()), "future_field": 1})
     assert LineageRecord.from_json(line) == r
+
+
+def test_lineage_record_version_spans_roundtrip_and_back_compat():
+    """Span-form lineage (in-flight weight updates) round-trips; PRE-span
+    lineage lines (no version_spans key) still load, defaulting to None —
+    old lineage.jsonl files stay readable."""
+    r = LineageRecord(step=9, weight_version=4, staleness=0.5, rows=8,
+                      truncation_rate=0.0, degenerate_rate=0.0,
+                      mean_score=2.0, time=9.0,
+                      version_spans=[[3, 40], [4, 24]])
+    got = LineageRecord.from_json(r.to_json())
+    assert got == r and got.version_spans == [[3, 40], [4, 24]]
+    old = {k: v for k, v in json.loads(r.to_json()).items() if k != "version_spans"}
+    loaded = LineageRecord.from_json(json.dumps(old))
+    assert loaded.version_spans is None
+    assert loaded.weight_version == 4
 
 
 def test_monitor_observe_chunk_writes_lineage_and_sentinels(tmp_path):

@@ -116,9 +116,11 @@ class PPOConfig(MethodConfig):
     # rollout_engine: route experience generation through the slot-based
     # engine — finished sequences free their slot immediately and a queued
     # prompt is prefilled into it, so mixed response lengths stop paying the
-    # whole-chunk straggler cost. Single-host; requires no soft prompts and
-    # no decode_weight_quant (the engine scores unfused — see PPOTrainer's
-    # validation).
+    # whole-chunk straggler cost. Runs multi-host (every controller makes
+    # the same slot decisions, verified per phase by the slot-schedule crc)
+    # and with decode_weight_quant (unfused-scoring delta bounded by the
+    # engine+int8 parity test); requires no soft prompts — see PPOTrainer's
+    # validation.
     rollout_engine: bool = False
     # engine_slots: size of the engine's fixed slot pool (the compiled decode
     # program's batch dimension). 0 = auto: chunk_size.
@@ -145,6 +147,16 @@ class PPOConfig(MethodConfig):
     # in one process through the same transports). Off (default) keeps every
     # existing path byte-identical.
     fleet_disaggregate: bool = False
+    # fleet_inflight_weights: let the fleet rollout worker adopt broadcast
+    # weights MID-PHASE — the engine loop polls weights_latest.json between
+    # decode syncs and stages the new version into RolloutEngine.
+    # update_weights (adopted at the next engine_steps_per_sync boundary; no
+    # drain, no abort). Episodes then carry per-token version_spans and the
+    # learner gates staleness at token granularity (fleet/
+    # mixed_version_tokens). Requires rollout_engine on the rollout side;
+    # silently inert on the chunked path. Off (default) keeps the PR 16
+    # phase-boundary adoption byte-identical.
+    fleet_inflight_weights: bool = False
 
 
 @dataclass

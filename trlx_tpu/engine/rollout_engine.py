@@ -31,9 +31,20 @@ single per-step key shared across slots — statistically equivalent but not
 bitwise equal to the chunked path, which is why the trainer only routes
 PPO's default sampled rollouts through the engine when asked
 (``method.rollout_engine``).
+
+Multi-process contract: every controller runs this SAME host-side loop over
+the SAME prompt set (submit the full global set on every host — never a
+per-process slice) so all hosts make identical admission/harvest/refill
+decisions and dispatch identical programs. Slot state and prefill inputs are
+lifted to fully-replicated global arrays (``_globalize``); the decision
+stream is fingerprinted (``schedule_fingerprint``) and cross-checked per
+phase by ``resilience.distributed.verify_engine_schedule`` so a desynced
+slot manager is named, not hung; the per-sync ``collective_guard`` turns a
+dead peer mid-decode into exit-117 + an incident bundle.
 """
 
 import time
+import zlib
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -61,7 +72,16 @@ class Episode:
     max_new_tokens budget with EXACTLY the whole-batch ``generate``
     convention (EOS token mask-1, post-finish positions pad/mask-0).
     ``decode_steps`` is the per-episode decode step count — free from the
-    slot length, no mask arithmetic needed."""
+    slot length, no mask arithmetic needed.
+
+    ``version_spans`` is the per-token weight-version provenance,
+    ``[(version, n_tokens), ...]`` in generation order, summing to
+    ``decode_steps``. A single-span episode (no in-flight push while the
+    slot was live) keeps ``weight_version == version_spans[0][0]``; a
+    mid-decode switch (PipelineRL-style in-flight update) splits the
+    episode at the sync boundary where the swap landed, and
+    ``weight_version`` reports the LAST span's version (the weights that
+    finished the episode)."""
 
     prompt_ids: np.ndarray
     prompt_mask: np.ndarray
@@ -69,6 +89,7 @@ class Episode:
     response_mask: np.ndarray
     decode_steps: int
     weight_version: Optional[int] = None
+    version_spans: Optional[list] = None
 
 
 class RolloutEngine:
@@ -101,6 +122,7 @@ class RolloutEngine:
         dispatch_lock=None,
         monitor=None,
         rng=None,
+        collective_deadline=None,
     ):
         if model.cfg.n_soft_tokens > 0:
             raise ValueError(
@@ -129,8 +151,33 @@ class RolloutEngine:
         self._slot_free_t = [None] * self.n_slots
         self._variables = None
         self.weight_version = None
+        # In-flight weight staging (PipelineRL, arxiv 2509.19128): pushes
+        # that arrive while slots are mid-decode are STAGED here and adopted
+        # at the top of the next step() — the engine_steps_per_sync boundary
+        # — never mid-scan. One staging cell, not a queue: a push storm
+        # coalesces to the latest version (``switches_coalesced`` counts the
+        # versions that were superseded before any decode step saw them).
+        self._staged = None
+        self._staged_lock = sanitize.make_lock("engine.staged_weights")
+        # Host copy of per-slot n_gen from the LAST device sync — the token
+        # position a mid-decode version switch lands at for each live slot.
+        self._n_gen_host = None
         self._state = None
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # Slot-schedule fingerprint: a rolling crc over every host-side slot
+        # decision (admission order, group widths, refill slot choices,
+        # harvest order). In a multi-process run every host must make the
+        # SAME decisions from the same data — a desynced schedule would hang
+        # in the collective decode; this crc lets resilience.distributed
+        # catch it by host name instead (ISSUE 17 / PR 2 fingerprint guards
+        # extended to the slot manager).
+        self._schedule_crc = 0
+        # Optional collective-guard deadline for multi-process decode syncs:
+        # when armed (process_count() > 1 and a deadline configured), the
+        # device_get after each decode dispatch runs under a watchdog so a
+        # dead peer host surfaces as exit-117 + incident bundle instead of a
+        # silent hang (mid_decode_host_kill drill).
+        self._collective_deadline = collective_deadline
 
         # Trace counters bump INSIDE the traced bodies (the make_generate_fn
         # idiom), so they count novel shapes only: decode must stay at 1 for
@@ -160,6 +207,8 @@ class RolloutEngine:
         self._completed = 0
         self._decode_wall = 0.0
         self._prefill_wall = 0.0
+        self._weight_switches = 0
+        self._switches_coalesced = 0
 
     def _dispatch(self):
         return self._lock if self._lock is not None else nullcontext()
@@ -189,25 +238,73 @@ class RolloutEngine:
     def update_weights(self, variables, version=None):
         """Explicit versioned weight handoff: ``variables`` is the decode
         variable dict (params [+ int8 qw]) from the trainer's snapshot /
-        re-quantize path — a stable copy, never the live donated state."""
+        re-quantize path — a stable copy, never the live donated state.
+
+        Callable at ANY time, including between sync points while slots are
+        mid-decode — no drain, no abort. The new version is STAGED on the
+        host and adopted at the top of the next ``step()`` (the
+        ``engine_steps_per_sync`` boundary), under the dispatch lock with
+        everything else the step does. Live slots record the token position
+        of the switch, so harvested Episodes carry per-token
+        ``version_spans``. Pushing again before adoption replaces the staged
+        version (coalesce-to-latest — a push storm never queues)."""
         # Sanitizer checkpoint: handing the engine a donated tree (e.g. the
         # trainer's pre-train_step state instead of the snapshot) fails HERE
         # with the donation site, not mid-decode with a deleted-array error.
         sanitize.check_host_read(variables, "engine.update_weights")
-        # The engine migrates threads at phase boundaries (producer thread in
-        # overlap mode, main thread serial / at teardown); each migration is
-        # ordered by the producer join or the phase handoff, and always passes
-        # through here first — reset the lockset history at the boundary.
-        sanitize.race_forget(self)
-        sanitize.race_access(self, "slot_state", write=True)
-        self._variables = variables
-        self.weight_version = version
         if obs_numerics.enabled():
             # graftnum quant-error probe at the handoff boundary: eager
             # round-trip over the handed-off params (+ an embedding-derived
             # KV proxy) — refreshes the num/quant_err_* gauges per version,
             # never touches the compiled decode programs.
             obs_numerics.record_weight_handoff(variables, version=version)
+        with self._staged_lock:
+            sanitize.race_access(self, "staged_weights", write=True)
+            if self._staged is not None and self._staged[1] != version:
+                # A staged version no decode step ever saw is superseded:
+                # coalesce, don't queue (version_switch_storm contract).
+                self._switches_coalesced += 1
+            self._staged = (variables, version)
+
+    def _adopt_staged(self):
+        """Swap in the staged weights at the sync boundary (top of step(),
+        before admission and the next decode dispatch). Every live slot
+        whose version actually changes records the switch position — the
+        tokens it has generated so far — so harvest can split its episode
+        into per-token version spans."""
+        with self._staged_lock:
+            sanitize.race_access(self, "staged_weights", write=True)
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        variables, version = staged
+        # The engine migrates threads at phase boundaries (producer thread in
+        # overlap mode, main thread serial / at teardown); each migration is
+        # ordered by the producer join or the phase handoff, and always
+        # passes through a fresh handoff first — reset the lockset history
+        # at the boundary. (Adoption runs on the step() thread, which is the
+        # only thread that ever touches slot_state.)
+        sanitize.race_forget(self)
+        sanitize.race_access(self, "slot_state", write=True)
+        if (
+            self._variables is not None
+            and version != self.weight_version
+            and self.live_slots > 0
+        ):
+            # Mid-decode switch: stamp the per-slot token position. n_gen
+            # from the last device sync IS the sync-boundary position — the
+            # swap lands before any further decode step.
+            for i in range(self.n_slots):
+                meta = self._slot_meta[i]
+                if meta is None:
+                    continue
+                pos = (
+                    int(self._n_gen_host[i]) if self._n_gen_host is not None else 0
+                )
+                meta.setdefault("switches", []).append((pos, version))
+            self._weight_switches += 1
+        self._variables = variables
+        self.weight_version = version
 
     def submit(self, input_ids, attention_mask) -> int:
         """Queue left-padded prompts ([n, width] or [width]) for decode."""
@@ -225,7 +322,11 @@ class RolloutEngine:
     def step(self):
         """One sync quantum: admit queued prompts into free slots, advance
         every live slot ``steps_per_sync`` tokens in the single compiled
-        decode program, harvest finished slots. Returns list[Episode]."""
+        decode program, harvest finished slots. Returns list[Episode].
+
+        The top of step() IS the sync boundary: a staged in-flight weight
+        push is adopted here, before admission and the decode dispatch."""
+        self._adopt_staged()
         if self._variables is None:
             raise RuntimeError(
                 "RolloutEngine.update_weights() must be called before step()"
@@ -238,15 +339,24 @@ class RolloutEngine:
             return []
         t0 = time.time()
         with trace_span("engine/decode", slots=n_live, steps=self.steps_per_sync):
-            with self._dispatch():
-                prev_state = self._state
-                self._state, live_steps = self._decode(self._variables, self._state)
-            # _decode donates the slot state (donate_argnums=(1,)).
-            sanitize.mark_donated(prev_state, "engine._decode(state) [step]")
-            del prev_state
-        finished, n_gen, live_steps = jax.device_get(
-            (self._state["finished"], self._state["n_gen"], live_steps)
-        )
+            with self._sync_guard():
+                with self._dispatch():
+                    prev_state = self._state
+                    self._state, live_steps = self._decode(
+                        self._variables, self._state
+                    )
+                # _decode donates the slot state (donate_argnums=(1,)).
+                sanitize.mark_donated(prev_state, "engine._decode(state) [step]")
+                del prev_state
+                # device_get sits OUTSIDE the dispatch lock (blocking on the
+                # program under the lock would serialize overlap's train
+                # dispatch against decode completion) but INSIDE the sync
+                # guard: in a multi-process run this is where a dead peer
+                # host turns into an indefinite collective wait.
+                finished, n_gen, live_steps = jax.device_get(
+                    (self._state["finished"], self._state["n_gen"], live_steps)
+                )
+        self._n_gen_host = np.asarray(n_gen)
         self._decode_wall += time.time() - t0
         self._decode_calls += 1
         self._decode_steps += self.steps_per_sync
@@ -260,6 +370,10 @@ class RolloutEngine:
             if self._slot_meta[i] is not None and bool(finished[i])
         ]
         if done:
+            # Harvest order is a slot-manager decision — fold it into the
+            # schedule fingerprint so a desynced harvest on one host is
+            # caught by name, not by a hung collective.
+            self._roll_schedule("harvest", *done)
             toks = np.asarray(jax.device_get(self._state["tokens"]), dtype=np.int32)
             R = int(self.gcfg.max_new_tokens)
             scope = graftscope.scope()
@@ -286,6 +400,7 @@ class RolloutEngine:
                     )
                 rmask = np.zeros((R,), dtype=np.int32)
                 rmask[:steps] = 1
+                spans = self._build_spans(meta, steps)
                 episodes.append(
                     Episode(
                         prompt_ids=meta["prompt_ids"],
@@ -293,12 +408,87 @@ class RolloutEngine:
                         response_ids=toks[i],
                         response_mask=rmask,
                         decode_steps=steps,
-                        weight_version=meta["version"],
+                        weight_version=spans[-1][0],
+                        version_spans=spans,
                     )
                 )
                 self._free.append(i)
             self._completed += len(done)
         return episodes
+
+    @staticmethod
+    def _build_spans(meta, steps):
+        """Per-token weight-version spans for one harvested slot:
+        ``[(version, n_tokens), ...]`` summing to ``steps``. Walks the
+        recorded ``(pos, version)`` switches in push order, clamping each
+        switch position into [0, steps], dropping zero-length segments and
+        merging adjacent equal versions."""
+        spans = []
+        cur_v = meta["version"]
+        cur_start = 0
+        for pos, v in meta.get("switches", ()):
+            pos = max(0, min(int(pos), int(steps)))
+            if v == cur_v:
+                continue
+            if pos > cur_start:
+                spans.append((cur_v, pos - cur_start))
+                cur_start = pos
+            cur_v = v
+        if steps > cur_start or not spans:
+            spans.append((cur_v, int(steps) - cur_start))
+        return spans
+
+    def _roll_schedule(self, tag, *vals):
+        """Fold one slot-manager decision into the rolling schedule crc."""
+        payload = (tag + ":" + ",".join(str(int(v)) for v in vals)).encode()
+        self._schedule_crc = zlib.crc32(payload, self._schedule_crc)
+
+    def schedule_fingerprint(self) -> int:
+        """Rolling crc32 over every admission/harvest decision this engine
+        has made — identical across hosts iff the slot schedules matched.
+        Verified cross-host by resilience.distributed.verify_engine_schedule
+        at engine phase boundaries."""
+        return self._schedule_crc
+
+    def slot_states(self) -> list:
+        """Host-side forensic summary of the in-flight slots — what a
+        mid-decode incident bundle records about the work that was live
+        when a peer host died."""
+        out = []
+        for i in range(self.n_slots):
+            meta = self._slot_meta[i]
+            if meta is None:
+                continue
+            out.append(
+                {
+                    "slot": i,
+                    "width": int(meta.get("width", len(meta["prompt_ids"]))),
+                    "version": meta["version"],
+                    "n_gen": (
+                        int(self._n_gen_host[i])
+                        if self._n_gen_host is not None
+                        else 0
+                    ),
+                    "switches": [
+                        [int(p), v] for p, v in meta.get("switches", ())
+                    ],
+                }
+            )
+        return out
+
+    def _sync_guard(self):
+        """Collective-guard context for the decode sync, armed only in
+        multi-process runs with a configured deadline — single-host stays
+        on the zero-overhead nullcontext path."""
+        if self._collective_deadline is None or jax.process_count() <= 1:
+            return nullcontext()
+        from trlx_tpu.resilience import distributed as dist_res
+
+        return dist_res.collective_guard(
+            "engine/decode_sync",
+            deadline=self._collective_deadline,
+            detail=lambda: {"slot_states": self.slot_states()},
+        )
 
     def _admit(self) -> int:
         """Refill free slots from the queue. Prefill is BATCHED: while any
@@ -318,6 +508,9 @@ class RolloutEngine:
             slots = np.asarray(
                 [self._free.pop() for _ in range(ids.shape[0])], dtype=np.int32
             )
+            # Admission is a slot-manager decision (which slots, what width,
+            # what group size) — fold it into the schedule fingerprint.
+            self._roll_schedule("admit", int(width), int(ids.shape[0]), *slots)
             t0 = time.time()
             with trace_span("engine/prefill", n=int(ids.shape[0]), width=int(width)):
                 with self._dispatch():
@@ -325,9 +518,13 @@ class RolloutEngine:
                     self._state = self._prefill(
                         self._variables,
                         self._state,
-                        jnp.asarray(ids),
-                        jnp.asarray(msk),
-                        jnp.asarray(slots),
+                        # _globalize: local jnp arrays in one process,
+                        # replicated global arrays when the mesh spans
+                        # processes (every host admits the SAME group — the
+                        # identical-prompt-set contract).
+                        self._globalize(ids),
+                        self._globalize(msk),
+                        self._globalize(slots),
                     )
                 # _prefill donates the slot state (donate_argnums=(1,)).
                 sanitize.mark_donated(prev_state, "engine._prefill(state) [admit]")
@@ -382,6 +579,8 @@ class RolloutEngine:
             "engine/prefill_wall_s": self._prefill_wall,
             "engine/decode_tokens_per_s": self._live_row_steps
             / max(self._decode_wall, 1e-9),
+            "engine/weight_switches": self._weight_switches,
+            "engine/switches_coalesced": self._switches_coalesced,
         }
         if reset:
             self._reset_counters()
@@ -397,7 +596,8 @@ class RolloutEngine:
         self._slot_free_t = [None] * self.n_slots
         if self._state is not None:
             self._state = dict(
-                self._state, active=jnp.zeros((self.n_slots,), dtype=bool)
+                self._state,
+                active=self._globalize(jnp.zeros((self.n_slots,), dtype=bool)),
             )
 
     def shutdown(self):
@@ -409,8 +609,11 @@ class RolloutEngine:
         # producer-side access before us — drop the stale lockset records.
         sanitize.race_forget(self)
         self.abort()
+        with self._staged_lock:
+            self._staged = None
         self._state = None
         self._variables = None
+        self._n_gen_host = None
 
     # ----------------------------------------------------------- device side
 
@@ -420,25 +623,68 @@ class RolloutEngine:
         cfg = self.model.cfg
         S, T, R = self.n_slots, self.cache_len, int(self.gcfg.max_new_tokens)
         cache = self._pin_cache(init_cache(cfg, S, T))
-        self._state = {
-            "cache": cache,
-            "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
-            "write_pos": jnp.zeros((S,), dtype=jnp.int32),
-            "n_gen": jnp.zeros((S,), dtype=jnp.int32),
-            "tokens": jnp.full((S, R), self.gcfg.pad_token_id, dtype=jnp.int32),
-            "active": jnp.zeros((S,), dtype=bool),
-            "finished": jnp.zeros((S,), dtype=bool),
-            "last_token": jnp.zeros((S,), dtype=jnp.int32),
-            "last_logits": jnp.zeros((S, cfg.vocab_size), dtype=jnp.float32),
-            "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
-            "rng": self._rng,
-        }
+        self._state = self._globalize(
+            {
+                "cache": cache,
+                "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
+                "write_pos": jnp.zeros((S,), dtype=jnp.int32),
+                "n_gen": jnp.zeros((S,), dtype=jnp.int32),
+                "tokens": jnp.full((S, R), self.gcfg.pad_token_id, dtype=jnp.int32),
+                "active": jnp.zeros((S,), dtype=bool),
+                "finished": jnp.zeros((S,), dtype=bool),
+                "last_token": jnp.zeros((S,), dtype=jnp.int32),
+                "last_logits": jnp.zeros((S, cfg.vocab_size), dtype=jnp.float32),
+                "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
+                "rng": self._rng,
+            }
+        )
+
+    def _globalize(self, tree):
+        """Make a host/process-local pytree a valid input for the engine's
+        jitted programs under the CURRENT mesh.
+
+        Single process: identity up to ``jnp.asarray`` — byte-identical to
+        the pre-multi-host path. Multi-process: the trainer's variables are
+        GLOBAL (multi-process) arrays, and jit refuses to mix them with
+        process-local inputs — so every host materialises its leaf (every
+        host computes the SAME value; the identical-schedule contract makes
+        that true for slot state and prefill groups alike) and lifts it to a
+        fully-REPLICATED global array via ``make_array_from_callback``.
+        Replication trades cache memory (each host holds the whole slot
+        cache) for the simplest possible availability story: any surviving
+        host owns a complete copy, and the slot manager needs no cross-host
+        index math. RNG keys ride through ``np.asarray`` (legacy uint32
+        keys)."""
+        if jax.process_count() <= 1:
+            return jax.tree_util.tree_map(jnp.asarray, tree)
+        from trlx_tpu.parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.peek_mesh()
+        if mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, tree)
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+        spec = NamedSharding(mesh, PSpec())
+
+        def lift(x):
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, spec, lambda idx, h=host: h[idx]
+            )
+
+        return jax.tree_util.tree_map(lift, tree)
 
     def _pin_cache(self, cache):
         # Same layout pin as ops/generate.py: slots over the data axes, heads
-        # over tp — skipped when the shapes don't divide the mesh.
+        # over tp — skipped when the shapes don't divide the mesh. In a
+        # multi-process world the pin is skipped outright: _globalize
+        # replicates the cache instead (see its docstring for the tradeoff),
+        # and an eager with_sharding_constraint on process-local leaves would
+        # not build a global array anyway.
         from trlx_tpu.parallel import mesh as mesh_mod
 
+        if jax.process_count() > 1:
+            return cache
         mesh = mesh_mod.peek_mesh()
         if mesh is None:
             return cache

@@ -107,6 +107,25 @@ class WeightPublisher:
             pointer.update(meta)
         append_record(self.paths.broadcast_log, rec)
         atomic_write_json(self.paths.latest_pointer, pointer)
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "weight_push_torn", ordinal
+        ):
+            # Torn-push drill: the pointer ALREADY names this ordinal, but
+            # the snapshot file it points at is truncated (publisher host
+            # killed mid-write, full disk). Subscribers must reject the torn
+            # load and keep decoding on the version they already hold.
+            with open(path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(path) // 2))
+            append_record(
+                self.paths.broadcast_log,
+                {
+                    "ordinal": ordinal,
+                    "version": int(version),
+                    "file": rec["file"],
+                    "status": "injected_torn",
+                    "t": time.time(),
+                },
+            )
         return ordinal
 
     def published(self) -> List[dict]:
@@ -132,6 +151,19 @@ class WeightSubscriber:
         with np.load(path, allow_pickle=False) as z:
             return [z[k] for k in sorted(z.files)]
 
+    def try_load(self, record: dict) -> Optional[List[np.ndarray]]:
+        """``load`` that treats a torn/truncated snapshot (publisher host
+        killed mid-write — the ``weight_push_torn`` drill) as not-there:
+        returns None instead of raising, so an in-flight weight poller can
+        keep decoding on the version it already holds and pick up the next
+        intact ordinal."""
+        import zipfile
+
+        try:
+            return self.load(record)
+        except (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile):
+            return None
+
     def fetch(
         self,
         min_ordinal: int,
@@ -150,13 +182,20 @@ class WeightSubscriber:
             while True:
                 rec = self.latest()
                 if rec is not None and int(rec["ordinal"]) >= int(min_ordinal):
-                    break
+                    # Torn-tolerant: a satisfying pointer whose snapshot file
+                    # is truncated (weight_push_torn — publisher killed
+                    # mid-write after the pointer flip) keeps us polling for
+                    # the next intact ordinal instead of crashing; the guard
+                    # deadline still bounds a publisher that never recovers.
+                    leaves = self.try_load(rec)
+                    if leaves is not None:
+                        break
                 if abort_check is not None and abort_check():
                     return None
                 if heartbeat is not None:
                     heartbeat.beat(phase=f"collective:{BROADCAST_GUARD}")
                 time.sleep(poll_interval)
-        return rec, self.load(rec)
+        return rec, leaves
 
 
 def put_leaves(template_params, host_leaves: List[np.ndarray]):
@@ -167,23 +206,30 @@ def put_leaves(template_params, host_leaves: List[np.ndarray]):
     Bitwise: no cast, no copy semantics beyond the host→device transfer."""
     import jax
 
-    ref_leaves, treedef = jax.tree_util.tree_flatten(template_params)
-    if len(ref_leaves) != len(host_leaves):
+    ref_with_path, treedef = jax.tree_util.tree_flatten_with_path(template_params)
+    if len(ref_with_path) != len(host_leaves):
         raise ValueError(
             f"weight broadcast leaf-count mismatch: snapshot has "
             f"{len(host_leaves)} leaves, this world's param tree has "
-            f"{len(ref_leaves)} — the jobs are not running the same model "
+            f"{len(ref_with_path)} — the jobs are not running the same model "
             "config."
         )
     put = []
-    for raw, ref in zip(host_leaves, ref_leaves):
+    for raw, (key_path, ref) in zip(host_leaves, ref_with_path):
         dt = np.dtype(ref.dtype)
         raw = np.asarray(raw)
         if raw.nbytes != ref.size * dt.itemsize:
+            # Name the first mismatched leaf BY PATH: a same-shape dtype
+            # misconfig (f32 learner → bf16 rollout world) looks like a
+            # byte-count skew on every leaf, and the path is what tells the
+            # operator which config knob diverged.
             raise ValueError(
-                f"weight broadcast leaf size mismatch: {raw.nbytes} bytes vs "
+                f"weight broadcast leaf size mismatch at param leaf "
+                f"{jax.tree_util.keystr(key_path)!r}: {raw.nbytes} bytes vs "
                 f"expected {ref.size * dt.itemsize} for shape {ref.shape} "
-                f"{dt} — the jobs are not running the same model config."
+                f"{dt} — the jobs are not running the same model config "
+                "(dtype mismatch, e.g. an f32 learner streaming to a bf16 "
+                "rollout world, shows up here as a per-leaf byte-count skew)."
             )
         host = raw.view(dt).reshape(ref.shape)
         put.append(jax.device_put(host, getattr(ref, "sharding", None)))

@@ -104,15 +104,29 @@ def fleet_snapshot(trainer, host_leaves, version: int) -> dict:
 
 
 def _read_cursor(paths: FleetPaths) -> int:
-    """The learner's consume cursor (count of consumed seqs). Missing or
-    torn = 0 — the worker just waits at the gate until it lands."""
+    """The learner's consume cursor (count of consumed seqs).
+
+    MISSING file = fresh fleet: 0 — the worker just waits at the gate
+    until it lands. A PRESENT-but-unparseable file (torn write from a
+    kill mid-write, torn read on a flaky shared filesystem) must NOT read
+    as 0: a restarted learner would silently re-consume — and re-train
+    on — every streamed batch. Fall back to the last indexed stream seq
+    + 1 instead: at-most-once (skip forward over batches whose consume
+    we cannot prove) rather than at-least-once (silent duplicates). The
+    cursor itself is written atomically (tmp + os.replace), so the
+    fallback only triggers on filesystem-level tears."""
     import json
 
     try:
         with open(paths.cursor, "r") as f:
-            return int(json.load(f)["consumed"])
-    except (OSError, ValueError, KeyError):
+            raw = f.read()
+    except OSError:
         return 0
+    try:
+        return int(json.loads(raw)["consumed"])
+    except (ValueError, KeyError, TypeError):
+        records = read_jsonl_or_empty(paths.stream_index)
+        return 1 + max((int(r["seq"]) for r in records), default=-1)
 
 
 def _event(paths: FleetPaths, role: str, event: str, **fields):
@@ -151,6 +165,61 @@ def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
 
     current_ordinal = -1
     snapshot = None
+    # In-flight weight updates (method.fleet_inflight_weights + the engine):
+    # poll the latest pointer BETWEEN engine syncs and push a fresher
+    # version into the running engine — PipelineRL-style, instead of only
+    # at phase boundaries. Default off: the phase-boundary path stays
+    # byte-identical to PR 16.
+    inflight = bool(
+        getattr(trainer.config.method, "fleet_inflight_weights", False)
+    ) and bool(getattr(trainer, "rollout_engine_enabled", False))
+    poll_state = {"tick": 0, "storm": 0}
+
+    def weight_poll():
+        """Once per engine sync: adopt a fresher broadcast ordinal into the
+        RUNNING phase. Returns (decode variables, version) to push, or None.
+        Torn snapshots (``weight_push_torn``) are rejected — keep decoding
+        on the version already held; the ``version_switch_storm`` fault
+        re-pushes the held latest every sync for a window, which the
+        engine must coalesce (never queue)."""
+        nonlocal current_ordinal, snapshot
+        poll_state["tick"] += 1
+        if trainer.fault_plan.fire("version_switch_storm", poll_state["tick"]):
+            poll_state["storm"] = int(
+                os.environ.get("TRLX_TPU_SWITCH_STORM_PUSHES", "8")
+            )
+        latest = subscriber.latest()
+        if latest is None:
+            return None
+        fresh = int(latest["ordinal"]) > current_ordinal
+        storm = poll_state["storm"] > 0
+        if storm:
+            poll_state["storm"] -= 1
+        if not fresh and not storm:
+            return None
+        if fresh:
+            leaves = subscriber.try_load(latest)
+            if leaves is None:
+                # Torn push: pointer flipped but the snapshot file is
+                # truncated. Reject — the engine keeps the old version —
+                # and retry at the next sync (the next intact ordinal wins).
+                _event(
+                    paths, ROLE_ROLLOUT, "weights_torn",
+                    ordinal=int(latest["ordinal"]), held=current_ordinal,
+                )
+                return None
+            snapshot = fleet_snapshot(trainer, leaves, latest["version"])
+            current_ordinal = int(latest["ordinal"])
+            if "kl_coef" in latest and getattr(trainer, "kl_ctl", None) is not None:
+                trainer.kl_ctl.value = float(latest["kl_coef"])
+            _event(
+                paths, ROLE_ROLLOUT, "weights_adopted_inflight",
+                ordinal=current_ordinal, version=snapshot["version"],
+            )
+        if snapshot is None:
+            return None
+        return trainer.rollout_engine_variables(snapshot), snapshot["version"]
+
     try:
         while not aborted():
             seq = writer.next_seq
@@ -174,7 +243,23 @@ def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
                     break  # coordinated shutdown while waiting
                 latest, leaves = got
             elif int(latest["ordinal"]) != current_ordinal:
-                leaves = subscriber.load(latest)
+                leaves = subscriber.try_load(latest)
+                if leaves is None:
+                    # Torn latest pointer at the phase boundary
+                    # (weight_push_torn): reject it exactly like the
+                    # in-flight poller — keep the held version when it still
+                    # satisfies the gate, otherwise spin at the gate until
+                    # the next intact ordinal lands (one event per torn
+                    # ordinal, not per spin).
+                    if poll_state.get("torn_seen") != int(latest["ordinal"]):
+                        poll_state["torn_seen"] = int(latest["ordinal"])
+                        _event(
+                            paths, ROLE_ROLLOUT, "weights_torn",
+                            ordinal=int(latest["ordinal"]), held=current_ordinal,
+                        )
+                    if current_ordinal < need or snapshot is None:
+                        time.sleep(0.05)
+                        continue
             else:
                 leaves = None
             if leaves is not None:
@@ -195,18 +280,32 @@ def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
                 heartbeat.beat(step=seq, phase="fleet:produce")
                 return aborted()
 
-            orch.make_experience(
+            info = orch.make_experience(
                 n_roll,
                 iter_count=snapshot["version"],
                 store=store,
                 snapshot=snapshot,
                 staleness=0,  # realized staleness is stamped at consume time
                 stop=produce_stop,
+                weight_poll=weight_poll if inflight else None,
             )
             if aborted():
                 break  # phase was cut short; drop the partial store
             heartbeat.beat(step=seq, phase="fleet:stream")
-            writer.append(store.columns(), weight_version=snapshot["version"])
+            writer.append(
+                store.columns(),
+                # In-flight adoption may have advanced the snapshot
+                # mid-phase: the tag is the LAST version that decoded, and
+                # the span aggregate carries the full per-token mix.
+                weight_version=snapshot["version"],
+                # Gated on the knob, not just the engine: with inflight off
+                # the index record stays byte-identical to PR 16's.
+                version_spans=(
+                    (info or {}).get("version_spans")
+                    if inflight and isinstance(info, dict)
+                    else None
+                ),
+            )
             _event(
                 paths, ROLE_ROLLOUT, "episode_streamed",
                 seq=seq, version=snapshot["version"], n=len(store),
@@ -264,6 +363,16 @@ class FleetLearnerFeed:
         self._subscriber = WeightSubscriber(self.paths) if self.role == ROLE_COLOCATED else None
         self._colo_ordinal = -1
         self._colo_snapshot = None
+        # Token-granularity staleness watch (in-flight weight updates): the
+        # detector rides the trainer's health monitor when one is armed —
+        # its state joins the health/* gauges and a CRIT escalates through
+        # the shared incident hook.
+        self._mixed_detector = None
+        monitor = getattr(trainer, "_health", None)
+        if monitor is not None:
+            from trlx_tpu.observability.health import MixedVersionDetector
+
+            self._mixed_detector = monitor.register_detector(MixedVersionDetector())
         _event(self.paths, self.role, "learner_start", consumed=self.consumed)
         self._export(staleness=0.0)
 
@@ -342,12 +451,40 @@ class FleetLearnerFeed:
             _event(self.paths, self.role, "unknown_version", seq=seq, version=version)
             v_ordinal = latest_ordinal
         staleness = max(0, latest_ordinal - v_ordinal)
+        # Token granularity (in-flight weight updates): a batch whose
+        # episodes straddle version switches carries a span aggregate. The
+        # cap gates on the FRESHEST span — those tokens are the batch's
+        # claim to being on-policy — while the older-token mix feeds the
+        # fleet/mixed_version_tokens gauge and its health detector instead
+        # of tripping the cap (some mix is the point of mid-decode pushes).
+        spans = rec.get("version_spans")
+        mixed_tokens = 0
+        total_tokens = 0
+        if spans:
+            span_stal = []
+            for v, k in spans:
+                vo = self._version_ordinal.get(int(v))
+                if vo is None:
+                    _event(
+                        self.paths, self.role, "unknown_version",
+                        seq=seq, version=int(v),
+                    )
+                    vo = latest_ordinal
+                span_stal.append((max(0, latest_ordinal - vo), int(k)))
+            freshest = min(s for s, _ in span_stal)
+            staleness = freshest
+            mixed_tokens = sum(k for s, k in span_stal if s > freshest)
+            total_tokens = sum(k for _, k in span_stal)
         if staleness > self.max_staleness:
             self._enter_degraded(self.triage or "staleness_cap")
             raise FleetDegradedExit(
                 "staleness_cap",
                 triage=self.triage,
                 detail=f"seq={seq} staleness={staleness} > cap={self.max_staleness}",
+            )
+        if self._mixed_detector is not None and total_tokens:
+            self._mixed_detector.observe(
+                {"mixed_tokens": mixed_tokens, "total_tokens": total_tokens}
             )
         cols = dict(self.reader.load(rec))
         n = int(rec.get("n", 0))
@@ -362,8 +499,13 @@ class FleetLearnerFeed:
         _event(
             self.paths, self.role, "episode_consumed",
             seq=seq, version=version, staleness=staleness, n=n, state=self.state,
+            **({"mixed_version_tokens": mixed_tokens} if spans else {}),
         )
-        self._export(staleness=float(staleness), version=version)
+        self._export(
+            staleness=float(staleness),
+            version=version,
+            mixed_tokens=float(mixed_tokens) if spans else None,
+        )
         return store
 
     # ---------------------------------------------------------- colocated
@@ -385,7 +527,7 @@ class FleetLearnerFeed:
                 self._colo_snapshot = fleet_snapshot(tr, leaves, latest["version"])
                 self._colo_ordinal = int(latest["ordinal"])
             store = PPORolloutStorage(tr.pad_token_id, record_staleness=True)
-            self.orch.make_experience(
+            info = self.orch.make_experience(
                 tr.config.method.num_rollouts,
                 iter_count=self._colo_snapshot["version"],
                 store=store,
@@ -393,7 +535,22 @@ class FleetLearnerFeed:
                 staleness=0,
                 stop=None,
             )
-            self._writer.append(store.columns(), weight_version=self._colo_snapshot["version"])
+            # Same span gating as the disaggregated worker. Colocated, no
+            # publish can land mid-phase (one process, publish only at the
+            # boundary) — so with the knob on every record carries exactly
+            # one span, which the acceptance test pins down.
+            inflight = bool(
+                getattr(tr.config.method, "fleet_inflight_weights", False)
+            ) and bool(getattr(tr, "rollout_engine_enabled", False))
+            self._writer.append(
+                store.columns(),
+                weight_version=self._colo_snapshot["version"],
+                version_spans=(
+                    (info or {}).get("version_spans")
+                    if inflight and isinstance(info, dict)
+                    else None
+                ),
+            )
             _event(
                 self.paths, self.role, "episode_streamed",
                 seq=seq, version=self._colo_snapshot["version"], n=len(store),
@@ -463,7 +620,7 @@ class FleetLearnerFeed:
 
     # --------------------------------------------------------- observability
 
-    def _export(self, staleness=None, version=None):
+    def _export(self, staleness=None, version=None, mixed_tokens=None):
         exporter = getattr(self.trainer, "_metrics_exporter", None)
         payload = {
             "state": self.state,
@@ -480,5 +637,9 @@ class FleetLearnerFeed:
             gauges["fleet/staleness"] = float(staleness)
         if version is not None:
             gauges["fleet/weight_version"] = float(version)
+        if mixed_tokens is not None:
+            # Tokens in the last consumed batch NOT produced by its freshest
+            # weight version — the in-flight update mix the detector watches.
+            gauges["fleet/mixed_version_tokens"] = float(mixed_tokens)
         exporter.update(gauges)
         exporter.set_fleet({"disaggregated": payload})

@@ -66,8 +66,20 @@ class EpisodeStreamWriter:
         records = read_jsonl_or_empty(paths.stream_index)
         self.next_seq = 1 + max((int(r["seq"]) for r in records), default=-1)
 
-    def append(self, columns: Dict[str, np.ndarray], weight_version: int) -> int:
-        """Write one episode batch atomically and index it. Returns seq."""
+    def append(
+        self,
+        columns: Dict[str, np.ndarray],
+        weight_version: int,
+        version_spans: Optional[list] = None,
+    ) -> int:
+        """Write one episode batch atomically and index it. Returns seq.
+
+        ``version_spans`` is the batch-aggregate per-token weight-version
+        provenance — ``[[version, n_tokens], ...]`` summed over the batch's
+        episodes (engine in-flight updates; Episode.version_spans). Omitted
+        (None) for phase-boundary batches, where ``weight_version`` alone
+        says everything: the index record stays byte-identical to PR 16's
+        on that path."""
         seq = self.next_seq
         if self.fault_plan is not None and self.fault_plan.fire("episode_stream_stall", seq):
             # Stall INSTEAD of writing: the batch never lands, but the
@@ -76,16 +88,16 @@ class EpisodeStreamWriter:
         path = self.paths.episode_file(seq)
         _atomic_savez(path, columns)
         n = int(next(iter(columns.values())).shape[0]) if columns else 0
-        append_record(
-            self.paths.stream_index,
-            {
-                "seq": seq,
-                "file": os.path.basename(path),
-                "n": n,
-                "weight_version": int(weight_version),
-                "t": time.time(),
-            },
-        )
+        rec = {
+            "seq": seq,
+            "file": os.path.basename(path),
+            "n": n,
+            "weight_version": int(weight_version),
+            "t": time.time(),
+        }
+        if version_spans:
+            rec["version_spans"] = [[int(v), int(k)] for v, k in version_spans]
+        append_record(self.paths.stream_index, rec)
         self.next_seq = seq + 1
         return seq
 

@@ -203,13 +203,24 @@ def validate_fleet_config(config) -> Optional[str]:
             f"train.fleet_role) — expected '{ROLE_ROLLOUT}', "
             f"'{ROLE_LEARNER}', or unset (colocated single-process mode)."
         )
-    if jax.process_count() > 1:
+    # Multi-host role submeshes: a role may itself be a multi-controller
+    # jax.distributed world (e.g. a 2-host rollout submesh decoding a model
+    # too large for one host). The fleet transports stay host-0-only by
+    # convention — every host in a role world computes the same host-side
+    # decisions (that is what the engine slot-schedule crc + PR 2
+    # fingerprints verify), and jax.process_index() == 0 does the
+    # stream/broadcast I/O for its role. What is still forbidden is putting
+    # DIFFERENT roles in ONE world: the roles run different device programs,
+    # which is exactly the cross-host divergence the fingerprint guards
+    # exist to reject.
+    if jax.process_count() > 1 and not env_role and not getattr(t, "fleet_role", None):
         raise ValueError(
-            "method.fleet_disaggregate couples SEPARATE single-controller "
-            "JAX worlds through train.fleet_dir — each role must be its own "
-            f"jax.distributed world (this one has {jax.process_count()} "
-            "processes). Launch the rollout and learner jobs as independent "
-            "processes instead of one multi-controller world."
+            "method.fleet_disaggregate in a multi-process world needs an "
+            f"explicit role ({ROLE_ENV} or train.fleet_role): every process "
+            "in one jax.distributed world must run the SAME role — the "
+            "colocated default would make this world both producer and "
+            "consumer. Give each role its own world (possibly multi-host) "
+            "and set the role explicitly."
         )
     if getattr(config.method, "rollout_overlap", False):
         raise ValueError(

@@ -64,6 +64,7 @@ __all__ = [
     "EntropyCollapseDetector",
     "ExplainedVarianceDetector",
     "RolloutSentinel",
+    "MixedVersionDetector",
     "LineageRecord",
     "HealthMonitor",
     "truncation_rate",
@@ -358,11 +359,47 @@ class RolloutSentinel(HysteresisDetector):
         return 0
 
 
+class MixedVersionDetector(HysteresisDetector):
+    """Token-granularity staleness watch for in-flight weight updates: the
+    fraction of a consumed batch's response tokens NOT produced by its
+    freshest weight version. Some mix is the whole point of pushing weights
+    mid-decode (episodes straddle a version switch); a batch that is MOSTLY
+    old tokens means pushes outpace decode and the learner is training on
+    yesterday's policy — WARN at ``warn_frac``, CRIT at ``crit_frac``.
+    Fed by the fleet learner feed (fleet/runner.py) alongside the
+    ``fleet/mixed_version_tokens`` gauge."""
+
+    name = "mixed_version"
+
+    def __init__(self, warn_frac: float = 0.5, crit_frac: float = 0.9, **kw):
+        super().__init__(**kw)
+        self.warn_frac = float(warn_frac)
+        self.crit_frac = float(crit_frac)
+        self.frac = 0.0
+
+    def severity(self, obs) -> int:
+        mixed = float(obs.get("mixed_tokens", 0.0))
+        total = float(obs.get("total_tokens", 0.0))
+        self.frac = mixed / total if total > 0 else 0.0
+        if self.frac >= self.crit_frac:
+            return 2
+        if self.frac >= self.warn_frac:
+            return 1
+        return 0
+
+
 @dataclass
 class LineageRecord:
     """Per-chunk provenance: which weights produced these rows, how stale
     they were by the time they train, and how degenerate they looked at the
-    host boundary. One JSON line per chunk in ``<ckpt_dir>/lineage.jsonl``."""
+    host boundary. One JSON line per chunk in ``<ckpt_dir>/lineage.jsonl``.
+
+    ``version_spans`` extends the scalar ``weight_version`` tag to span
+    form for in-flight weight updates (PR 17): ``[[version, n_tokens],
+    ...]`` aggregated over the chunk's episodes — which versions produced
+    HOW MANY of the chunk's tokens, not just which version finished it.
+    None on the phase-boundary paths, so pre-span lineage files load
+    unchanged (``from_json`` defaults missing fields)."""
 
     step: int
     weight_version: int
@@ -372,6 +409,7 @@ class LineageRecord:
     degenerate_rate: float
     mean_score: float
     time: float
+    version_spans: list = None
 
     def to_json(self) -> str:
         return json.dumps(asdict(self))
@@ -379,7 +417,9 @@ class LineageRecord:
     @classmethod
     def from_json(cls, line: str) -> "LineageRecord":
         d = json.loads(line)
-        return cls(**{k: d[k] for k in cls.__dataclass_fields__})
+        return cls(
+            **{k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        )
 
 
 class HealthMonitor:
@@ -494,11 +534,14 @@ class HealthMonitor:
 
     def observe_chunk(self, tokens_h, mask_h, prompt_length: int, *, scores,
                       weight_version: int, staleness, step: int,
-                      reward_call=None):
+                      reward_call=None, version_spans=None):
         """Rollout-boundary feed, one call per scored chunk: reward drift
         over the chunk's mean score, the degenerate-sample sentinels over
         its token grid, and the chunk's lineage record. ``reward_call`` is
-        the chunk's reward-call index (drill offset keying)."""
+        the chunk's reward-call index (drill offset keying);
+        ``version_spans`` is the chunk's per-token weight-version aggregate
+        (``[[version, n_tokens], ...]``, engine in-flight updates) — None
+        keeps the record byte-compatible with the scalar-tag paths."""
         scores = np.asarray(scores, dtype=np.float64)
         offset = self._reward_offset_for(reward_call)
         mean_score = float(scores.mean()) + offset if scores.size else 0.0
@@ -513,6 +556,11 @@ class HealthMonitor:
             degenerate_rate=degen,
             mean_score=mean_score,
             time=time.time(),
+            version_spans=(
+                [[None if v is None else int(v), int(k)] for v, k in version_spans]
+                if version_spans
+                else None
+            ),
         )
         with self._lock:
             self.reward.observe(mean_score)
@@ -523,7 +571,12 @@ class HealthMonitor:
                 try:
                     # Line-atomic single-write append (utils/jsonl contract):
                     # a killed host tears at most the final lineage record.
-                    jsonl.append_record(self.lineage_path, asdict(record))
+                    # The spans field is span-form-only: scalar-tag records
+                    # stay byte-identical to pre-span lineage files.
+                    rec_d = asdict(record)
+                    if rec_d.get("version_spans") is None:
+                        rec_d.pop("version_spans", None)
+                    jsonl.append_record(self.lineage_path, rec_d)
                 except OSError:
                     pass  # lineage is an audit trail, never a crash source
 

@@ -349,10 +349,22 @@ def _embedding_proxy(params, rows: int = 64):
     return jnp.asarray(emb[:take]).reshape(1, take, 1, int(emb.shape[1]))
 
 
+# Versions seen at recent weight handoffs, newest-last. With in-flight
+# updates (engine.update_weights mid-decode) a phase's episodes can span
+# SEVERAL versions — the per-version quant gauges above only tag the
+# latest, so this window is what says how many versions are concurrently
+# "live" in decode output (the span-form companion of the PR 15 scalar
+# telemetry). Sized to comfortably cover one experience phase.
+_HANDOFF_VERSIONS: list = []
+_HANDOFF_WINDOW = 8
+
+
 def record_weight_handoff(variables, version=None) -> dict:
     """Quant-error probe at a versioned weight handoff (engine
     ``update_weights`` / W8A16 snapshot): weight round-trip error per
-    kernel class plus the embedding-proxy KV error. No-op when disarmed."""
+    kernel class plus the embedding-proxy KV error, plus the count of
+    distinct versions across the recent handoff window
+    (``num/quant_versions_in_flight``). No-op when disarmed."""
     if _STATE is None or not isinstance(variables, dict):
         return {}
     params = variables.get("params")
@@ -362,6 +374,12 @@ def record_weight_handoff(variables, version=None) -> dict:
     proxy = _embedding_proxy(params)
     if proxy is not None:
         gauges.update(record_kv_quant(proxy))
+    if version is not None:
+        _HANDOFF_VERSIONS.append(int(version))
+        del _HANDOFF_VERSIONS[:-_HANDOFF_WINDOW]
+        inflight = {"num/quant_versions_in_flight": float(len(set(_HANDOFF_VERSIONS)))}
+        _STATE.update_gauges(inflight)
+        gauges.update(inflight)
     return gauges
 
 
@@ -535,6 +553,9 @@ def configure() -> _Numerics:
     into this run)."""
     global _STATE
     _STATE = _Numerics()
+    # A prior run's handoff-version window must not inflate this run's
+    # versions-in-flight gauge.
+    del _HANDOFF_VERSIONS[:]
     return _STATE
 
 

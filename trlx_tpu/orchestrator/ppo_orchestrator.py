@@ -19,6 +19,7 @@ loader can prefetch (device work is enqueued, not awaited, until arrays are
 read) — the reference serializes these phases.
 """
 
+import os
 import time
 from collections import deque
 
@@ -126,6 +127,7 @@ class PPOOrchestrator(Orchestrator):
         snapshot=None,
         staleness: int = 0,
         stop=None,
+        weight_poll=None,
     ):
         """Fill a rollout store with `num_rollouts` rollout rows
         (reference: trlx/orchestrator/ppo_orchestrator.py:50-130).
@@ -162,7 +164,12 @@ class PPOOrchestrator(Orchestrator):
                 snapshot=snapshot,
                 staleness=staleness,
                 stop=stop,
+                weight_poll=weight_poll,
             )
+        # ``weight_poll`` (in-flight weight updates) is an engine-path
+        # contract: the chunked whole-batch path has no sync boundary to
+        # adopt at mid-phase, so a poller is silently unused here and the
+        # phase keeps its boundary snapshot — same behavior as PR 16.
         store = store if store is not None else rl.store
         record_staleness = bool(getattr(store, "record_staleness", False))
         timer = getattr(rl, "_phase_timer", None)
@@ -461,6 +468,7 @@ class PPOOrchestrator(Orchestrator):
         snapshot=None,
         staleness: int = 0,
         stop=None,
+        weight_poll=None,
     ):
         """Continuous-batching experience generation (method.rollout_engine).
 
@@ -473,8 +481,17 @@ class PPOOrchestrator(Orchestrator):
         (optionally on the ScoreWorker thread), unfused device scoring, store
         push, health feed. The phase drains fully before returning: no episode
         crosses a phase boundary, so every stored row's lineage is this
-        phase's weight handoff (explicit `update_weights`, never the live
-        donated TrainState)."""
+        phase's weight handoffs (explicit `update_weights`, never the live
+        donated TrainState).
+
+        ``weight_poll`` (optional zero-arg callable → None or
+        ``(variables, version)``) is checked once per engine sync: a
+        non-None result is pushed into the RUNNING engine mid-phase —
+        in-flight weight updates, PipelineRL-style. No drain, no abort:
+        the engine stages the push and swaps at its next sync boundary,
+        and harvested episodes carry per-token ``version_spans``. Returns
+        ``{"version_spans": [[version, n_tokens], ...]}`` (the phase
+        aggregate) on success, None on abort."""
         rl = self.rl_model
         store = store if store is not None else rl.store
         record_staleness = bool(getattr(store, "record_staleness", False))
@@ -516,6 +533,9 @@ class PPOOrchestrator(Orchestrator):
         clock = Clock()
         gen_s = reward_s = score_s = push_s = 0.0
         episode_steps = []
+        span_agg = {}  # version -> total tokens, the phase-level lineage
+        fault_plan = getattr(rl, "fault_plan", None)
+        sync_tick = 0
         last_scores = np.zeros((1,), dtype=np.float32)
         last_kl = np.zeros((1, 1), dtype=np.float32)
 
@@ -565,6 +585,7 @@ class PPOOrchestrator(Orchestrator):
                     staleness=staleness,
                     step=iter_count,
                     reward_call=reward_call,
+                    version_spans=ctx.get("version_spans"),
                 )
             last_scores, last_kl = np.asarray(scores), kl
 
@@ -586,6 +607,7 @@ class PPOOrchestrator(Orchestrator):
             n = len(eps)
             tokens_h = np.full((n, P_full + R), pad_id, dtype=np.int32)
             mask_h = np.zeros((n, P_full + R), dtype=np.int32)
+            chunk_spans = {}
             for i, e in enumerate(eps):
                 w = int(e.prompt_ids.shape[0])
                 tokens_h[i, P_full - w : P_full] = e.prompt_ids
@@ -593,12 +615,22 @@ class PPOOrchestrator(Orchestrator):
                 tokens_h[i, P_full:] = e.response_ids
                 mask_h[i, P_full:] = e.response_mask
                 episode_steps.append(int(e.decode_steps))
+                # Per-token weight-version provenance: aggregate the
+                # episode spans into a chunk histogram (and the phase one)
+                # for the lineage/stream records.
+                for v, k in e.version_spans or ((e.weight_version, e.decode_steps),):
+                    chunk_spans[v] = chunk_spans.get(v, 0) + int(k)
+                    span_agg[v] = span_agg.get(v, 0) + int(k)
             dev = rl.put_batch({"tokens": tokens_h, "mask": mask_h})
             return {
                 "tokens": dev["tokens"],
                 "mask": dev["mask"],
                 "tokens_h": tokens_h,
                 "mask_h": mask_h,
+                "version_spans": sorted(
+                    ([v, k] for v, k in chunk_spans.items()),
+                    key=lambda s: (s[0] is None, s[0]),
+                ),
             }
 
         worker = None
@@ -620,6 +652,23 @@ class PPOOrchestrator(Orchestrator):
                     return
                 if heartbeat is not None:
                     heartbeat.beat(step=iter_count, phase="rollout")
+                if weight_poll is not None:
+                    pushed = weight_poll()
+                    if pushed is not None:
+                        # In-flight update: staged now, adopted at the top
+                        # of engine.step() — the sync boundary. Live slots
+                        # keep decoding; episodes split into version spans.
+                        new_vars, new_version = pushed
+                        engine.update_weights(new_vars, version=new_version)
+                sync_tick += 1
+                if fault_plan is not None and fault_plan.fire(
+                    "mid_decode_host_kill", sync_tick
+                ):
+                    # Abrupt mid-phase death with slots live: no cleanup, no
+                    # final heartbeat — the surviving hosts' decode-sync
+                    # collective guard must turn this into exit 117 + an
+                    # incident bundle naming this host and their slot states.
+                    os._exit(1)
                 t = time.time()
                 eps = engine.step()
                 gen_s += time.time() - t
@@ -673,6 +722,25 @@ class PPOOrchestrator(Orchestrator):
         if aborted:
             return
 
+        if jax.process_count() > 1:
+            # Multi-process engine phase: every host must have made the SAME
+            # admission/harvest decisions (the decode program is collective).
+            # A desynced slot schedule is caught here by host name at the
+            # phase boundary — not as a hung collective next phase. The
+            # outer guard adds the engine's slot states to the incident
+            # bundle when a PEER never arrives (mid_decode_host_kill: on
+            # meshes whose decode has no cross-host comm, this allgather is
+            # where survivors first block on the dead host).
+            from trlx_tpu.resilience import distributed as dist_res
+
+            with dist_res.collective_guard(
+                "engine/schedule_verify",
+                detail=lambda: {"slot_states": engine.slot_states()},
+            ):
+                dist_res.verify_engine_schedule(
+                    engine.schedule_fingerprint(), phase=iter_count
+                )
+
         eng = engine.stats(reset=True)
         exp_time = clock.tick()
         stats = {
@@ -703,3 +771,9 @@ class PPOOrchestrator(Orchestrator):
             stats["exp_staleness"] = float(staleness)
         rl._last_exp_stats = {"exp_per_sec": stats["exp_per_sec"]}
         rl.tracker.log(stats, step=iter_count)
+        return {
+            "version_spans": sorted(
+                ([v, k] for v, k in span_agg.items()),
+                key=lambda s: (s[0] is None, s[0]),
+            )
+        }

@@ -25,11 +25,18 @@ dependencies, and ALL of it is off unless the method config sets
 ``rollout_overlap`` / ``max_staleness`` (the serial schedule stays the
 byte-compatible default).
 
-Single-process scope: the producer here double-buffers WITHIN one process.
-The disaggregated rollout/learner fleet (trlx_tpu/fleet,
+Process scope: the producer here double-buffers WITHIN one process, but
+that process may be one controller of a multi-host world. Every host runs
+the identical producer schedule (chunk boundaries and handoff points are
+pure functions of the config and device-synced values), and the
+phase-boundary fingerprint checks (resilience.distributed) turn any
+divergence into a named HostDesync rather than a hung collective — which
+is what lets the multi-host guard in trainer/ppo.py stay lifted. The
+disaggregated rollout/learner fleet (trlx_tpu/fleet,
 method.fleet_disaggregate) runs the same staleness gate — shared via
-:func:`staleness_gate_open` — across two separate jobs coupled by an episode
-stream and a versioned weight broadcast.
+:func:`staleness_gate_open` — across two separate jobs (each possibly its
+own multi-host submesh) coupled by an episode stream and a versioned
+weight broadcast.
 """
 
 import queue

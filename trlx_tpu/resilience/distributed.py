@@ -259,10 +259,15 @@ class collective_guard:
         name: str,
         deadline: Optional[float] = None,
         on_timeout: Optional[Callable] = None,
+        detail: Optional[Callable] = None,
     ):
         self.name = name
         self.deadline = _CONFIG["deadline"] if deadline is None else float(deadline)
         self.on_timeout = on_timeout or _CONFIG["on_timeout"] or _default_on_timeout
+        # Optional zero-arg callable returning extra forensic fields for the
+        # incident bundle (e.g. the engine's in-flight slot states on a
+        # mid-decode peer death). Evaluated only on the timeout path.
+        self.detail = detail
         self._timer = None
 
     def _fire(self):
@@ -273,6 +278,12 @@ class collective_guard:
                 step = provider()
             except Exception:
                 step = None
+        extra = {}
+        if self.detail is not None:
+            try:
+                extra = dict(self.detail())
+            except Exception:  # noqa: BLE001 — forensics must not block abort
+                extra = {}
         # Observability last-gasp: an instant on this thread's span lane plus
         # a best-effort incident bundle (thread stacks name the wedged peer
         # collective) BEFORE on_timeout — the default handler os._exit()s.
@@ -284,7 +295,7 @@ class collective_guard:
                 "collective_timeout", collective=self.name, deadline_s=self.deadline
             )
             _obs_anomaly.emergency_capture(
-                "collective_timeout", detail={"collective": self.name}
+                "collective_timeout", detail={"collective": self.name, **extra}
             )
         except Exception:  # noqa: BLE001 — the abort path must still abort
             pass
@@ -297,7 +308,11 @@ class collective_guard:
 
             _obs_fleet.incident_bundle(
                 step, "collective_timeout",
-                detail={"collective": self.name, "deadline_s": self.deadline},
+                detail={
+                    "collective": self.name,
+                    "deadline_s": self.deadline,
+                    **extra,
+                },
             )
         except Exception:  # noqa: BLE001 — the abort path must still abort
             pass
@@ -448,6 +463,54 @@ def verify_fingerprints(fingerprint: np.ndarray) -> None:
     from trlx_tpu.parallel.mesh import allgather_host
 
     compare_fingerprints(allgather_host(fingerprint[None, :]))
+
+
+def verify_engine_schedule(schedule_crc: int, phase: Optional[int] = None) -> None:
+    """Cross-host check that every host's slot manager made the SAME
+    admission/harvest decisions this rollout phase (the engine's rolling
+    schedule crc — see RolloutEngine.schedule_fingerprint()). In a
+    multi-process engine run, a host whose slot schedule diverged would
+    dispatch a decode program with different live rows and hang the fleet
+    inside a collective; this check catches it by host name at the phase
+    boundary instead. Single process: trivially consistent.
+
+    Drill hook: ``TRLX_TPU_ENGINE_SCHEDULE_SKEW`` (a nonzero int) XORs THIS
+    host's reported crc — the injection signature of a desynced slot
+    manager, same idiom as ``perturb_local_replicas`` (a real divergence
+    would wedge in the decode collective before any check could run, so the
+    drill skews the report, not the schedule)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from trlx_tpu.parallel.mesh import allgather_host
+
+    crc = int(schedule_crc) & 0xFFFFFFFF
+    skew = int(os.environ.get("TRLX_TPU_ENGINE_SCHEDULE_SKEW", "0") or "0")
+    if skew:
+        crc ^= skew & 0xFFFFFFFF
+    row = np.asarray([int(phase or 0), crc], dtype=np.int64)
+    gathered = np.asarray(allgather_host(row[None, :])).reshape(-1, 2)
+    reference = gathered[0]
+    problems = []
+    fields = ("engine phase counter", "slot schedule crc32")
+    for host in range(1, gathered.shape[0]):
+        bad = [
+            f"{fields[j]} {gathered[host, j]} != {reference[j]}"
+            for j in range(gathered.shape[1])
+            if gathered[host, j] != reference[j]
+        ]
+        if bad:
+            problems.append(f"host {host}: " + ", ".join(bad))
+    if problems:
+        raise HostDesync(
+            "engine slot-schedule check failed vs host 0 — "
+            + "; ".join(problems)
+            + ". The slot managers made different admission/harvest "
+            "decisions (non-deterministic host code or skewed prompt "
+            "data); the next decode dispatch would hang the fleet in a "
+            "collective. Restart the phase with identical per-host inputs."
+        )
 
 
 # ------------------------------------------------------------- drill support

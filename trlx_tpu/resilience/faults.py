@@ -87,7 +87,26 @@ different ``TRLX_TPU_FAULTS`` on each role; tests/test_fleet_disagg.py):
                        ordinal N → the rollout worker's guarded wait for
                        the version its staleness gate requires outlives
                        ``train.fleet_broadcast_deadline`` and aborts with
-                       ``CollectiveTimeout`` (exit 117).
+                       ``CollectiveTimeout`` (exit 117);
+- ``weight_push_torn@N``     — weight broadcast ordinal N flips the
+                       ``weights_latest.json`` pointer but its leaf snapshot
+                       file is truncated mid-write → the subscriber's load
+                       must REJECT the torn snapshot and the engine keeps
+                       decoding on the old version (no crash, no partial
+                       adoption); the next intact ordinal adopts normally;
+- ``version_switch_storm@N`` — from broadcast-poll tick N on, the consumer
+                       re-pushes the latest weights into the running engine
+                       EVERY sync for ``TRLX_TPU_SWITCH_STORM_PUSHES``
+                       (default 8) polls → the engine must coalesce staged
+                       versions to the latest (``engine/switches_coalesced``
+                       counts the supersessions), never queue them;
+- ``mid_decode_host_kill@N`` — this process dies abruptly (``os._exit(1)``)
+                       at the Nth engine sync INSIDE an active rollout
+                       phase, slots mid-decode → surviving hosts block in
+                       the engine's decode-sync collective, hit the
+                       collective-guard deadline, exit 117, and the incident
+                       bundle names the dead host and the in-flight slot
+                       states.
 """
 
 import os
@@ -115,6 +134,9 @@ KINDS = (
     "rollout_host_kill",
     "episode_stream_stall",
     "broadcast_timeout",
+    "weight_push_torn",
+    "version_switch_storm",
+    "mid_decode_host_kill",
 )
 
 _ENTRY_RE = re.compile(r"^([a-z_]+)@(\d+)$")
