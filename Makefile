@@ -44,7 +44,8 @@ test-shard3:
 test-multihost:
 	$(TEST_ENV) python -m pytest -q -m slow \
 	    tests/test_multihost.py tests/test_distributed_resilience.py \
-	    tests/test_fleet_drill.py tests/test_fleet_disagg.py
+	    tests/test_fleet_drill.py tests/test_fleet_disagg.py \
+	    tests/test_fleet_elastic.py
 
 # 2-process fleet drills under the full runtime sanitizer set: graftfleet's
 # slow_host drill (merged clock-aligned trace, skew table naming the
@@ -61,7 +62,8 @@ test-multihost:
 # single-controller worlds); RUNBOOK §14/§16 have the triage.
 fleet-drill:
 	$(TEST_ENV) TRLX_TPU_SANITIZE=dispatch,donation,race python -m pytest -q \
-	    -m slow tests/test_fleet_drill.py tests/test_fleet_disagg.py
+	    -m slow tests/test_fleet_drill.py tests/test_fleet_disagg.py \
+	    tests/test_fleet_elastic.py
 
 # graftlint + graftrace: AST invariant (GL001-GL007, RUNBOOK §11) and
 # concurrency (GL008-GL011, RUNBOOK §13) checks in one pass. Blocking,
@@ -114,7 +116,9 @@ bench-reference:
 # flagship head layout + static tile legality at the full bench shape +
 # a tiny bucketed rollout (trace count <= n_buckets) + the decode_engine
 # probe (slot decode parity vs static batch, occupancy > 0.85, engine
-# tokens/s above the static rate). Writes BENCH_SMOKE.json.
+# tokens/s above the static rate) + the fleet_elastic probe (episodes/s
+# through the real lease/stream/intake transports at 1 vs 2 workers,
+# exactly-once asserted, 2-worker speedup > 1.3x). Writes BENCH_SMOKE.json.
 bench-smoke:
 	$(TEST_ENV) python bench_smoke.py
 

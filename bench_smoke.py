@@ -1,6 +1,6 @@
 """CPU smoke of the decode hot path: minutes, no TPU, CI-safe.
 
-Two probes covering exactly what BENCH_r05 showed CPU CI was blind to:
+Probes covering exactly what BENCH_r05 showed CPU CI was blind to:
 
 1. kernel — the flash-decode Pallas kernel runs in INTERPRET mode at the
    flagship head layout (h=16, d=256) over an int8 KV cache with a ragged
@@ -32,6 +32,15 @@ Two probes covering exactly what BENCH_r05 showed CPU CI was blind to:
    token for token, keep slot occupancy > 85%, and deliver HIGHER decode
    tokens/s than the static-batch path (the straggler steps the slot refill
    reclaims). Both rates land in BENCH_SMOKE.json.
+
+6. fleet_elastic — elastic N-worker fleet transport throughput
+   (trlx_tpu/fleet, RUNBOOK §18): threaded workers with a fixed synthetic
+   produce cost drive the real lease ledger + per-worker stream indexes +
+   exactly-once intake at 1 worker then 2. Intake must stay exactly-once
+   (every unit chosen once, zero duplicates, no reclaims) and the 2-worker
+   run must beat the 1-worker rate by > 1.3x — the claim/append/consume
+   transports must overlap workers, not serialize them. Episodes/s for
+   both fleet sizes land in BENCH_SMOKE.json.
 
 Writes BENCH_SMOKE.json and prints one JSON summary line; exits 1 on any
 failure. Wall time ~1-2 min on a laptop CPU.
@@ -429,6 +438,114 @@ def _decode_engine_probe_meshless():
     }
 
 
+def fleet_elastic_probe():
+    """Elastic fleet transport throughput: episode batches/s through the
+    REAL lease ledger + per-worker stream indexes + exactly-once intake
+    (trlx_tpu/fleet, RUNBOOK §18), at 1 worker vs 2. Workers are threads
+    with a fixed synthetic produce cost standing in for generation — no
+    model, no mesh — so the number isolates what the probe is for: the
+    claim/append/consume transports must let N workers overlap, not
+    serialize them. Intake must stay exactly-once either way."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from trlx_tpu.fleet import (
+        ElasticStreamReader,
+        EpisodeStreamWriter,
+        FleetPaths,
+        LeaseLedger,
+        WorkerRegistry,
+    )
+
+    UNITS, S, BATCH = 24, 4, 16
+    PRODUCE_S = 0.02  # modeled per-unit generation cost (dominates transport)
+    cols = {
+        "query_tensors": np.ones((BATCH, 8), np.int32),
+        "query_mask": np.ones((BATCH, 8), np.int32),
+        "response_tensors": np.ones((BATCH, 8), np.int32),
+        "response_mask": np.ones((BATCH, 8), np.int32),
+    }
+
+    def run_fleet(n_workers: int, root: str) -> float:
+        paths = FleetPaths(root=root).ensure_elastic()
+        ledger = LeaseLedger(paths.leases_dir, ttl=60.0)
+        registry = WorkerRegistry(paths.workers_dir)
+        cursor = {"consumed": 0}
+        lock = threading.Lock()
+
+        def worker(wid: int):
+            registry.register(wid)
+            writer = EpisodeStreamWriter(paths, worker=wid)
+            while True:
+                with lock:
+                    consumed = cursor["consumed"]
+                if consumed >= UNITS:
+                    return
+                lease = None
+                for unit in range(consumed, min(UNITS, consumed + S + 1)):
+                    got = ledger.try_claim(unit, wid)
+                    if got is not None:
+                        lease = got
+                        break
+                if lease is None:
+                    time.sleep(0.002)
+                    continue
+                time.sleep(PRODUCE_S)
+                writer.append(cols, weight_version=0, unit=lease.unit)
+                ledger.complete(lease)
+
+        reader = ElasticStreamReader(paths)
+        threads = [
+            threading.Thread(target=worker, args=(k,), name=f"smoke-fleet-w{k}", daemon=True)
+            for k in range(n_workers)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for unit in range(UNITS):
+            rec = reader.wait(unit, timeout=30.0, retries=1, backoff=0.1)
+            loaded = reader.load(rec)
+            assert int(next(iter(loaded.values())).shape[0]) == BATCH
+            with lock:
+                cursor["consumed"] = unit + 1
+        wall = time.time() - t0
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads), "fleet worker thread leaked"
+        # Exactly-once: every unit chosen once, zero duplicates (nothing
+        # died, so the O_EXCL ledger must have prevented every double claim).
+        assert sorted(reader.chosen()) == list(range(UNITS))
+        assert reader.duplicates() == 0, f"{reader.duplicates()} duplicate records"
+        assert ledger.reclaimed_units() == []
+        assert sorted(registry.active()) == list(range(n_workers))
+        return wall
+
+    with tempfile.TemporaryDirectory() as tmp:
+        wall_1 = run_fleet(1, os.path.join(tmp, "fleet1"))
+        wall_2 = run_fleet(2, os.path.join(tmp, "fleet2"))
+    rate_1 = UNITS / max(wall_1, 1e-9)
+    rate_2 = UNITS / max(wall_2, 1e-9)
+    speedup = rate_2 / max(rate_1, 1e-9)
+    # 2 workers over a 20ms produce cost should approach 2x; 1.3x is the
+    # "transports do not serialize the fleet" floor with CI noise headroom.
+    assert speedup > 1.3, (
+        f"2-worker elastic fleet {rate_2:.1f} units/s is not ahead of "
+        f"1-worker {rate_1:.1f} units/s (speedup {speedup:.2f})"
+    )
+    return {
+        "units": UNITS,
+        "episodes_per_batch": BATCH,
+        "units_per_s_1worker": round(rate_1, 1),
+        "units_per_s_2workers": round(rate_2, 1),
+        "episodes_per_s_1worker": round(rate_1 * BATCH, 1),
+        "episodes_per_s_2workers": round(rate_2 * BATCH, 1),
+        "speedup": round(speedup, 2),
+        "seconds": round(wall_1 + wall_2, 2),
+    }
+
+
 def main():
     from trlx_tpu.observability.graftscope import RunManifest
 
@@ -445,6 +562,7 @@ def main():
         ("overlap", overlap_probe),
         ("fused_loss", fused_loss_probe),
         ("decode_engine", decode_engine_probe),
+        ("fleet_elastic", fleet_elastic_probe),
     ):
         manifest.heartbeat("probe", candidate=name)
         result[name] = probe()

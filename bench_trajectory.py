@@ -207,6 +207,13 @@ def _parse_smoke(path: str):
             out["static_decode_tokens_per_s"] = float(engine["static_decode_tokens_per_s"])
         if isinstance(engine.get("slot_occupancy"), (int, float)):
             out["engine_slot_occupancy"] = float(engine["slot_occupancy"])
+    fleet = smoke.get("fleet_elastic", {})
+    if isinstance(fleet.get("episodes_per_s_2workers"), (int, float)):
+        out["fleet_episodes_per_s_2workers"] = float(fleet["episodes_per_s_2workers"])
+        if isinstance(fleet.get("episodes_per_s_1worker"), (int, float)):
+            out["fleet_episodes_per_s_1worker"] = float(fleet["episodes_per_s_1worker"])
+        if isinstance(fleet.get("speedup"), (int, float)):
+            out["fleet_elastic_speedup"] = float(fleet["speedup"])
     return out
 
 

@@ -1,0 +1,822 @@
+"""Elastic N-worker rollout fleet (trlx_tpu/fleet + method.fleet_elastic).
+
+Fast tier (in-process): the lease ledger's atomic claim/renew/expire/
+reclaim-generation lifecycle, the O_EXCL worker registry with clean leave
+and incarnation bumps, the deterministic prompt-shard seek that lets ANY
+worker reproduce ANY work unit, and the acceptance identity — a COLOCATED
+elastic run (the inline producer IS worker 0, claiming leases and tagging
+units through the whole elastic machinery) at max_staleness=0 is
+bitwise-identical to the non-elastic colocated fleet. Fully sanitized.
+
+Slow tier (multi-process CPU drills, learner + N workers, each its own
+single-controller JAX world coupled only via train.fleet_dir):
+
+- ``worker_kill_mid_lease@N``: one of two workers dies holding a lease,
+  nothing streamed → the survivor reclaims the unit at the next lease
+  generation and the learner consumes EVERY work unit exactly once — no
+  gap, no duplicate — and training completes.
+- ``slow_worker_reclaim@N``: a worker outsleeps its lease TTL mid-hold,
+  then produces anyway → the reclaimer already produced the same unit, two
+  records land, and the (work_unit, episode_key) dedup consumes exactly one.
+- join + leave: a worker deregisters cleanly mid-run while another worker
+  JOINS mid-run (adopting the latest broadcast, never a historical one).
+- all-workers-dead: the sole worker dies → per-worker triage reads dead,
+  the learner degrades gracefully per the PR 16 contract and exits 0.
+- 2-worker staleness-0 parity: N-worker elastic losses bitwise equal to a
+  serial run.
+
+When ``TRLX_TPU_DRILL_ARTIFACTS`` is set (the CI fleet-drill job does),
+each drill exports the lease ledger, every per-worker stream index, and
+the dedup/reclaim counters alongside the PR 16 artifacts.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.fleet import (  # noqa: E402
+    ElasticStreamReader,
+    FleetPaths,
+    LeaseLedger,
+    WorkerRegistry,
+    validate_fleet_config,
+)
+from trlx_tpu.fleet.topology import (  # noqa: E402
+    WORKER_ENV,
+    read_jsonl_or_empty,
+    role_timeouts,
+)
+
+SANITIZE = "dispatch,donation,race"
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+# ----------------------------------------------------------- lease ledger
+
+
+def _ledger(tmp_path, ttl=60.0):
+    paths = FleetPaths(root=str(tmp_path / "fleet")).ensure_elastic()
+    return LeaseLedger(paths.leases_dir, ttl=ttl), paths
+
+
+def test_lease_claim_is_exclusive_and_renewable(tmp_path):
+    led, _ = _ledger(tmp_path)
+    lease = led.try_claim(0, worker=0)
+    assert lease is not None and lease.gen == 0 and lease.worker == 0
+    # A fresh-held unit is unclaimable by a peer; the owner re-adopts it.
+    assert led.try_claim(0, worker=1) is None
+    again = led.try_claim(0, worker=0)
+    assert again is not None and again.gen == 0
+    renewed = led.renew(lease)
+    assert renewed is not None and renewed.expires >= lease.expires
+    assert [l.unit for l in led.held_by(0)] == [0]
+    assert led.reclaimed_units() == []
+
+
+def test_expired_lease_reclaims_at_next_generation(tmp_path):
+    led, _ = _ledger(tmp_path, ttl=0.2)
+    l0 = led.try_claim(3, worker=0)
+    assert l0.gen == 0
+    time.sleep(0.3)
+    l1 = led.try_claim(3, worker=1)
+    assert l1 is not None and l1.gen == 1 and l1.worker == 1
+    # The dead owner's stale handle lost: renew/complete refuse quietly.
+    assert led.renew(l0) is None
+    assert led.complete(l0) is False
+    assert led.complete(l1) is True
+    assert led.reclaimed_units() == [3]
+    # A done unit is never claimable again, any worker, any generation.
+    assert led.try_claim(3, worker=0) is None
+    assert led.try_claim(3, worker=1) is None
+
+
+def test_released_lease_reclaims_without_waiting_for_ttl(tmp_path):
+    led, _ = _ledger(tmp_path, ttl=60.0)
+    l0 = led.try_claim(1, worker=0)
+    assert led.release(l0)
+    l1 = led.try_claim(1, worker=1)  # instant: no TTL wait on a clean leave
+    assert l1 is not None and l1.gen == 1
+    assert led.reclaimed_units() == [1]
+
+
+def test_torn_claim_file_reads_as_fresh_hold_not_free(tmp_path):
+    """A lease file caught mid-write must read HELD (claimable only after
+    the mtime+ttl grace), never free — two workers double-claiming a unit
+    on a torn read is exactly the race the O_EXCL ledger exists to kill."""
+    led, paths = _ledger(tmp_path, ttl=0.3)
+    with open(os.path.join(paths.leases_dir, "unit_000007.gen000.json"), "w") as f:
+        f.write('{"unit": 7, "wor')
+    assert led.try_claim(7, worker=1) is None  # fresh torn file: held
+    time.sleep(0.4)
+    got = led.try_claim(7, worker=1)  # grace elapsed: reclaim, next gen
+    assert got is not None and got.gen == 1
+
+
+def test_worker_registry_auto_ids_leave_and_incarnation(tmp_path):
+    paths = FleetPaths(root=str(tmp_path / "fleet")).ensure_elastic()
+    reg = WorkerRegistry(paths.workers_dir)
+    assert reg.register() == 0
+    assert reg.register() == 1  # lowest free slot via O_EXCL
+    assert sorted(reg.active()) == [0, 1]
+    reg.leave(0)
+    assert reg.active() == [1]
+    assert reg.workers()[0]["status"] == "left"
+    # A left slot is NOT auto-reused (ids stay stable for the event log)...
+    assert reg.register() == 2
+    # ...but an explicit re-register of the same id bumps its incarnation.
+    assert reg.register(0) == 0
+    assert reg.workers()[0]["status"] == "active"
+    assert reg.workers()[0]["incarnation"] == 1
+
+
+# ------------------------------------------------------------- validation
+
+
+def _config(**train_overrides):
+    config = base_config("ppo", 15, 8)
+    for k, v in train_overrides.items():
+        setattr(config.train, k, v)
+    return config
+
+
+def test_fleet_elastic_requires_disaggregate(monkeypatch):
+    monkeypatch.delenv(WORKER_ENV, raising=False)
+    config = _config()
+    config.method.fleet_elastic = True
+    with pytest.raises(ValueError, match="fleet_disaggregate"):
+        validate_fleet_config(config)
+
+
+def test_worker_env_and_lease_ttl_require_elastic(monkeypatch):
+    config = _config()
+    config.method.fleet_disaggregate = True
+    monkeypatch.setenv(WORKER_ENV, "1")
+    with pytest.raises(ValueError, match=WORKER_ENV):
+        validate_fleet_config(config)
+    monkeypatch.delenv(WORKER_ENV, raising=False)
+    config.train.fleet_lease_ttl = 5.0
+    with pytest.raises(ValueError, match="fleet_lease_ttl"):
+        validate_fleet_config(config)
+    config.method.fleet_elastic = True
+    assert validate_fleet_config(config) == "colocated"
+    monkeypatch.setenv(WORKER_ENV, "banana")
+    with pytest.raises(ValueError, match="non-negative"):
+        validate_fleet_config(config)
+
+
+def test_lease_ttl_resolution_defaults_from_heartbeat(monkeypatch):
+    t = _config().train
+    assert role_timeouts(t)["lease_ttl"] == 3.0  # max(6 * 0.5, 3.0)
+    t = _config(heartbeat_interval=2.0).train
+    assert role_timeouts(t)["lease_ttl"] == 12.0
+    t = _config(fleet_lease_ttl=7.5).train
+    assert role_timeouts(t)["lease_ttl"] == 7.5
+
+
+# ---------------------------------------------- deterministic prompt seek
+
+
+def test_seek_chunks_reproduces_any_units_prompt_shard():
+    """Work-unit portability: any worker, at any time, must rebuild the
+    exact prompt chunks of any unit — that is what makes a reclaimed unit
+    carry the dead owner's episode_key. seek_chunks forward-winds (or
+    rebuilds + winds, for a unit behind the local position) the seeded
+    shuffle loader, so two orchestrators at different histories converge."""
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    class _Orch:
+        chunks_per_unit = PPOOrchestrator.chunks_per_unit
+        seek_chunks = PPOOrchestrator.seek_chunks
+        _next_prompt_batch = PPOOrchestrator._next_prompt_batch
+
+        def __init__(self):
+            self.pipeline = PromptPipeline(
+                [[i % 13 + 1] for i in range(32)], max_prompt_length=1
+            )
+            self.chunk_size = 8
+            self.pipeline_loader = self.pipeline.create_loader(self.chunk_size, shuffle=True)
+            self.pipeline_iterator = iter(self.pipeline_loader)
+            self._chunks_consumed = 0
+
+    a = _Orch()
+    schedule = [np.asarray(a._next_prompt_batch()["input_ids"]).copy() for _ in range(10)]
+    assert a.chunks_per_unit(16) == 2  # ceil(16 rollouts / 8 chunk)
+
+    # A joiner seeks forward to unit 3's shard (chunks 6,7) from scratch.
+    b = _Orch()
+    b.seek_chunks(3 * 2)
+    assert np.array_equal(np.asarray(b._next_prompt_batch()["input_ids"]), schedule[6])
+    assert np.array_equal(np.asarray(b._next_prompt_batch()["input_ids"]), schedule[7])
+    # A reclaimer seeks BACKWARD (rebuild + rewind) to unit 1's shard.
+    b.seek_chunks(1 * 2)
+    assert np.array_equal(np.asarray(b._next_prompt_batch()["input_ids"]), schedule[2])
+    # And the original, past an epoch wrap, stays on the same schedule.
+    a.seek_chunks(4)
+    assert np.array_equal(np.asarray(a._next_prompt_batch()["input_ids"]), schedule[4])
+
+
+# ------------------------------------------------ colocated parity (fast)
+
+
+def _run_ppo(task, ckpt_dir, fleet=False, elastic=False, steps=4, **overrides):
+    _, logit_mask, metric_fn, reward_fn = task
+    config = base_config("ppo", 15, 8)
+    config.train.total_steps = steps
+    config.train.epochs = 4
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.train.checkpoint_dir = str(ckpt_dir)
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    if fleet:
+        config.method.fleet_disaggregate = True
+        config.train.fleet_dir = str(ckpt_dir) + "_fleet"
+    if elastic:
+        config.method.fleet_elastic = True
+    for k, v in overrides.items():
+        setattr(config.method, k, v)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    with open(os.path.join(str(ckpt_dir), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    return model, records
+
+
+def test_colocated_elastic_staleness0_matches_non_elastic_bitwise(task, tmp_path, monkeypatch):
+    """Acceptance identity: flipping method.fleet_elastic on the colocated
+    staleness-0 fleet — every unit now lease-claimed, seek-scheduled, and
+    unit-tagged through the ledger — changes the loss trajectory by
+    NOTHING (bitwise). The elastic run's stream records carry unit/worker/
+    episode_key; the non-elastic run's stay byte-identical to PR 16's."""
+    from trlx_tpu.utils import sanitize
+
+    monkeypatch.setenv(sanitize.ENV_VAR, SANITIZE)
+    try:
+        _, plain = _run_ppo(task, tmp_path / "plain", fleet=True, max_staleness=0)
+        model, elastic = _run_ppo(
+            task, tmp_path / "elastic", fleet=True, elastic=True, max_staleness=0
+        )
+    finally:
+        monkeypatch.delenv(sanitize.ENV_VAR, raising=False)
+        sanitize.refresh()
+        sanitize.clear_donated()
+        sanitize.clear_races()
+
+    losses_plain = [r["loss"] for r in plain if "loss" in r]
+    losses_elastic = [r["loss"] for r in elastic if "loss" in r]
+    assert len(losses_plain) == 4
+    assert losses_elastic == losses_plain
+
+    plain_dir = str(tmp_path / "plain") + "_fleet"
+    elastic_dir = str(tmp_path / "elastic") + "_fleet"
+    # Non-elastic layout untouched: no ledger, no registry, PR 16 records.
+    assert not os.path.exists(os.path.join(plain_dir, "leases"))
+    stream_plain = read_jsonl_or_empty(os.path.join(plain_dir, "stream.jsonl"))
+    assert stream_plain and all("unit" not in r for r in stream_plain)
+    # Elastic layout: every record unit-tagged by worker 0, every unit's
+    # lease claimed at gen 0 and completed, registry holds the inline worker.
+    stream = read_jsonl_or_empty(os.path.join(elastic_dir, "stream.jsonl"))
+    assert stream and [r["unit"] for r in stream] == [r["seq"] for r in stream]
+    assert all(r["worker"] == 0 and r["episode_key"] for r in stream)
+    paths = FleetPaths(root=elastic_dir)
+    ledger = LeaseLedger(paths.leases_dir, ttl=60.0)
+    states = ledger.units()
+    assert sorted(states) == [r["unit"] for r in stream]
+    assert all(l.status == "done" and l.gen == 0 for l in states.values())
+    assert WorkerRegistry(paths.workers_dir).workers()[0]["status"] == "active"
+    # Elastic consume events carry unit+worker; cursor carries stream marks.
+    events = read_jsonl_or_empty(os.path.join(elastic_dir, "fleet_events.jsonl"))
+    consumed = [e for e in events if e["event"] == "episode_consumed"]
+    assert consumed and [e["unit"] for e in consumed] == sorted({e["unit"] for e in consumed})
+    with open(os.path.join(elastic_dir, "learner_cursor.json")) as f:
+        cursor = json.load(f)
+    assert cursor["streams"]["0"] == cursor["consumed"]
+    assert model._fleet_feed is None
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+
+
+# --------------------------------------------------- multi-process drills
+
+_ELASTIC_WORKER = r"""
+import json, os, sys, threading, time
+import urllib.request
+import numpy as np
+
+role = sys.argv[1]            # "serial" | "rollout" | "learner"
+ckpt = sys.argv[2]
+fleet_dir = sys.argv[3]
+S = int(sys.argv[4])
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["TRLX_TPU_NO_PROGRESS"] = "1"
+
+sys.path.insert(0, os.path.join(os.environ["TRLX_REPO"], "examples"))
+import trlx_tpu
+from randomwalks import base_config, generate_random_walks
+
+_, logit_mask, metric_fn, reward_fn = generate_random_walks(
+    n_nodes=15, max_length=8, n_walks=60, seed=1000
+)
+
+config = base_config("ppo", 15, 8)
+config.train.total_steps = int(os.environ.get("TOTAL", "8"))
+config.train.epochs = int(os.environ.get("EPOCHS", "4"))
+config.train.batch_size = 16
+config.train.eval_interval = 100
+config.train.checkpoint_dir = ckpt
+config.method.num_rollouts = 16
+config.method.chunk_size = 16
+if role != "serial":
+    config.method.fleet_disaggregate = True
+    config.method.fleet_elastic = True
+    config.method.max_staleness = S
+    config.train.fleet_dir = fleet_dir
+    # Drill-scale timing: seconds, not the production minutes.
+    config.train.heartbeat_interval = 0.2
+    config.train.fleet_episode_timeout = 2.0
+    config.train.fleet_stream_retries = 1
+    config.train.fleet_stream_backoff = 0.2
+    config.train.fleet_heartbeat_timeout = float(os.environ.get("HB_TIMEOUT", "3.0"))
+    config.train.fleet_broadcast_deadline = float(os.environ.get("BDEADLINE", "120"))
+    config.train.fleet_lease_ttl = float(os.environ.get("LEASE_TTL", "1.0"))
+
+scrapes_stop = threading.Event()
+
+def scrape_loop():
+    # Live witnesses: the per-worker /healthz workers block (satellite:
+    # worker id, heartbeat age, lease count, triage state) and the
+    # worker-labeled fleet/* gauge series must be observable DURING the
+    # run, not reconstructed post-hoc.
+    mport = int(os.environ.get("TRLX_TPU_METRICS_PORT", "0"))
+    while not scrapes_stop.is_set():
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/healthz", timeout=2
+            ) as r:
+                payload = json.loads(r.read().decode())
+            fleet = payload.get("fleet", {})
+            if fleet.get("workers"):
+                with open(os.path.join(ckpt, "scrape_workers.json"), "w") as f:
+                    json.dump(fleet, f)
+            if fleet.get("disaggregated", {}).get("state") == "degraded":
+                with open(os.path.join(ckpt, "scrape_degraded.json"), "w") as f:
+                    json.dump(payload, f)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=2
+            ) as r:
+                body = r.read().decode()
+            if 'trlx_tpu_fleet_worker_state{worker="' in body:
+                with open(os.path.join(ckpt, "scrape_metrics.txt"), "w") as f:
+                    f.write(body)
+        except Exception:
+            pass  # exporter not up yet / mid-teardown
+        scrapes_stop.wait(0.05)
+
+scraper = None
+if role == "learner" and os.environ.get("TRLX_TPU_METRICS_PORT"):
+    os.makedirs(ckpt, exist_ok=True)
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+
+prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+try:
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=[[1]],
+        metric_fn=metric_fn, config=config, logit_mask=logit_mask,
+    )
+finally:
+    scrapes_stop.set()
+    if scraper is not None:
+        scraper.join(timeout=5)
+
+if role in ("serial", "learner"):
+    with open(os.path.join(ckpt, "metrics.jsonl")) as f:
+        losses = [json.loads(l).get("loss") for l in f]
+    print("LOSSES", json.dumps([l for l in losses if l is not None]))
+print("THREADS", json.dumps([t.name for t in threading.enumerate() if t.name.startswith("trlx-")]))
+print(f"fleet role {role} DONE")
+"""
+
+
+def _script(tmp_path):
+    script = tmp_path / "fleet_elastic_worker.py"
+    script.write_text(_ELASTIC_WORKER)
+    return str(script)
+
+
+def _launch(tmp_path, role, ckpt, fleet_dir, staleness, extra_env=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("TRLX_TPU_FAULTS", None)
+    env.pop("TRLX_TPU_METRICS_PORT", None)
+    env.pop(WORKER_ENV, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env["TRLX_REPO"] = repo
+    env["TRLX_TPU_SANITIZE"] = SANITIZE
+    if role != "serial":
+        env["TRLX_TPU_FLEET_ROLE"] = role
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, _script(tmp_path), role, str(ckpt), str(fleet_dir), str(staleness)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _communicate(proc, timeout=900):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        pytest.skip("elastic fleet drill did not complete in this environment")
+    return out.decode(errors="replace")
+
+
+def _events(fleet_dir):
+    return read_jsonl_or_empty(os.path.join(str(fleet_dir), "fleet_events.jsonl"))
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _export_artifacts(fleet_dir, logs):
+    dest = os.environ.get("TRLX_TPU_DRILL_ARTIFACTS")
+    if not dest:
+        return
+    os.makedirs(dest, exist_ok=True)
+    fleet_dir = str(fleet_dir)
+    for name in ("broadcast.jsonl", "fleet_events.jsonl", "weights_latest.json",
+                 "abort.json", "learner_cursor.json"):
+        src = os.path.join(fleet_dir, name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(dest, name))
+    # Elastic surface: every per-worker stream index, the lease ledger, and
+    # the dedup/reclaim counters the drill asserted on.
+    if os.path.isdir(fleet_dir):
+        for name in sorted(os.listdir(fleet_dir)):
+            if name == "stream.jsonl" or (name.startswith("stream.w") and name.endswith(".jsonl")):
+                shutil.copy(os.path.join(fleet_dir, name), os.path.join(dest, name))
+    leases = os.path.join(fleet_dir, "leases")
+    if os.path.isdir(leases):
+        shutil.copytree(leases, os.path.join(dest, "leases"), dirs_exist_ok=True)
+    workers = os.path.join(fleet_dir, "workers")
+    if os.path.isdir(workers):
+        shutil.copytree(workers, os.path.join(dest, "workers"), dirs_exist_ok=True)
+    paths = FleetPaths(root=fleet_dir)
+    if os.path.isdir(fleet_dir):
+        reader = ElasticStreamReader(paths)
+        ledger = LeaseLedger(paths.leases_dir, ttl=60.0)
+        with open(os.path.join(dest, "dedup_counters.json"), "w") as f:
+            json.dump(
+                {
+                    "episodes_deduped_total": reader.duplicates(),
+                    "units_reclaimed_total": len(ledger.reclaimed_units())
+                    if os.path.isdir(leases)
+                    else 0,
+                    "units": sorted(reader.chosen()),
+                },
+                f,
+            )
+    for name, text in logs.items():
+        with open(os.path.join(dest, name), "w") as f:
+            f.write(text)
+
+
+def _assert_clean_threads(out, who):
+    lines = [l for l in out.splitlines() if l.startswith("THREADS ")]
+    assert lines, f"{who} never reported its thread census:\n{out[-2000:]}"
+    assert json.loads(lines[-1][len("THREADS "):]) == [], f"{who} leaked threads: {lines[-1]}"
+
+
+def _consumed_units(fleet_dir):
+    return [e["unit"] for e in _events(fleet_dir) if e["event"] == "episode_consumed"]
+
+
+@pytest.mark.slow
+def test_fleet_drill_worker_kill_mid_lease_exactly_once(tmp_path):
+    """The flagship elastic drill: learner + 2 workers, worker 0 dies
+    abruptly RIGHT AFTER claiming its first unit >= 1 — lease held, nothing
+    streamed. The survivor reclaims the orphaned unit at the next lease
+    generation and the learner consumes every work unit EXACTLY once (no
+    gap where the dead worker's unit was, no duplicate from the reclaim),
+    completes training, and coordinates a clean shutdown."""
+    fleet_dir = tmp_path / "fleet"
+    # 4 work units: each epoch trains one unit for ppo_epochs (4) steps, so
+    # TOTAL = 4 * EPOCHS walks the bootstrap unit + 3 post-epoch consumes.
+    # HB_TIMEOUT stays generous: triage is not under test here — the TTL
+    # reclaim is — and a mid-compile worker must not read as stalled.
+    env = {"TOTAL": "16", "EPOCHS": "4", "LEASE_TTL": "1.0", "HB_TIMEOUT": "10"}
+    w0 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w0", fleet_dir, 1,
+        {**env, WORKER_ENV: "0", "TRLX_TPU_FAULTS": "worker_kill_mid_lease@1"},
+    )
+    w1 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w1", fleet_dir, 1,
+        {**env, WORKER_ENV: "1"},
+    )
+    logs = {}
+    try:
+        mport = _free_port()
+        learner = _launch(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 1,
+            {**env, "TRLX_TPU_METRICS_PORT": str(mport)},
+        )
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w0 = logs["worker0.log"] = _communicate(w0, timeout=120)
+        out_w1 = logs["worker1.log"] = _communicate(w1, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert w0.returncode == 1, f"worker0 should os._exit(1):\n{out_w0[-4000:]}"
+        assert w1.returncode == 0, f"worker1 failed:\n{out_w1[-4000:]}"
+
+        # EXACTLY once: units 0..3, strictly in order, no gap, no repeat.
+        assert _consumed_units(fleet_dir) == [0, 1, 2, 3]
+        events = _events(fleet_dir)
+        # The orphaned unit came back at a bumped lease generation, claimed
+        # by the survivor.
+        reclaims = [e for e in events if e["event"] == "lease_reclaimed"]
+        assert reclaims and all(e["gen"] >= 1 for e in reclaims)
+        assert any(e["worker"] == 1 for e in reclaims)
+        paths = FleetPaths(root=str(fleet_dir))
+        ledger = LeaseLedger(paths.leases_dir, ttl=60.0)
+        assert ledger.reclaimed_units()
+        # Both workers registered; every consumed record's producer is one
+        # of them; the survivor produced the tail.
+        registered = {e["worker"] for e in events if e["event"] == "worker_registered"}
+        assert registered == {0, 1}
+        producers = {e["worker"] for e in events if e["event"] == "episode_consumed"}
+        assert producers <= {0, 1} and 1 in producers
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] == "complete"
+
+        # Live satellite witness: per-worker labeled gauges and the
+        # /healthz workers block were scraped DURING the run.
+        with open(os.path.join(str(tmp_path / "ckpt_l"), "scrape_metrics.txt")) as f:
+            body = f.read()
+        assert 'trlx_tpu_fleet_worker_state{worker="0"}' in body
+        assert 'trlx_tpu_fleet_worker_state{worker="1"}' in body
+        assert 'trlx_tpu_fleet_worker_heartbeat_age{worker="' in body
+        assert "trlx_tpu_fleet_units_reclaimed_total" in body
+        with open(os.path.join(str(tmp_path / "ckpt_l"), "scrape_workers.json")) as f:
+            fleet_block = json.load(f)
+        for wid, w in fleet_block["workers"].items():
+            assert wid in ("0", "1")
+            assert {"state", "heartbeat_age", "leases_held", "incarnation"} <= set(w)
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w1, "worker1")
+    finally:
+        for p in (w0, w1):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_fleet_drill_slow_worker_reclaim_dedups_exactly_once(tmp_path):
+    """slow_worker_reclaim@1 on worker 0: it outsleeps its lease TTL while
+    holding a unit, the peer reclaims AND produces that unit, then the
+    sleeper wakes and produces it AGAIN. Two records land for one work
+    unit; the learner's (work_unit, episode_key) intake consumes exactly
+    one and counts the duplicate. Nobody crashes; training completes."""
+    fleet_dir = tmp_path / "fleet"
+    # 6 work units (TOTAL = 4 * EPOCHS). The sleep fires at the first claim
+    # of a unit >= 2, so the sleeper has already produced (and compiled) at
+    # least one unit: its duplicate lands seconds before the run can end.
+    env = {"TOTAL": "24", "EPOCHS": "6", "LEASE_TTL": "1.0", "HB_TIMEOUT": "10"}
+    w0 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w0", fleet_dir, 1,
+        {**env, WORKER_ENV: "0", "TRLX_TPU_FAULTS": "slow_worker_reclaim@2",
+         "TRLX_TPU_SLOW_WORKER_SECONDS": "2.5"},
+    )
+    w1 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w1", fleet_dir, 1,
+        {**env, WORKER_ENV: "1"},
+    )
+    logs = {}
+    try:
+        learner = _launch(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 1, env)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w0 = logs["worker0.log"] = _communicate(w0, timeout=120)
+        out_w1 = logs["worker1.log"] = _communicate(w1, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert w0.returncode == 0, f"worker0 failed:\n{out_w0[-4000:]}"
+        assert w1.returncode == 0, f"worker1 failed:\n{out_w1[-4000:]}"
+
+        # Exactly-once intake despite the double production.
+        assert _consumed_units(fleet_dir) == list(range(6))
+        paths = FleetPaths(root=str(fleet_dir))
+        reader = ElasticStreamReader(paths)
+        assert reader.duplicates() >= 1
+        # The duplicated unit landed in BOTH workers' streams with the SAME
+        # prompt-shard content key (deterministic seek), different seqs.
+        dup_units = [u for u, recs in reader.by_unit().items() if len(recs) > 1]
+        assert dup_units
+        for u in dup_units:
+            recs = reader.by_unit()[u]
+            assert {r["worker"] for r in recs} == {0, 1}
+            assert len({r["episode_key"] for r in recs}) == 1
+        ledger = LeaseLedger(paths.leases_dir, ttl=60.0)
+        assert set(dup_units) <= set(ledger.reclaimed_units())
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] == "complete"
+        _assert_clean_threads(out_l, "learner")
+    finally:
+        for p in (w0, w1):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_fleet_drill_worker_join_and_leave_mid_run(tmp_path):
+    """Dynamic membership: worker 0 produces two units then deregisters
+    CLEANLY (releasing any held lease); worker 1 defers registration until
+    the learner's cursor reaches 2 — a true mid-run join that adopts the
+    LATEST broadcast — and carries the run to completion. Every unit is
+    consumed exactly once across the membership change."""
+    fleet_dir = tmp_path / "fleet"
+    # 6 work units (TOTAL = 4 * EPOCHS). No reclaim belongs in this drill —
+    # a clean leave releases instantly — so the TTL is slack enough that the
+    # leaver's units never expire mid-produce, and HB_TIMEOUT rides out the
+    # joiner's first JIT compile (progress frozen while the learner is hot).
+    env = {"TOTAL": "24", "EPOCHS": "6", "LEASE_TTL": "5.0", "HB_TIMEOUT": "15"}
+    w0 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w0", fleet_dir, 1,
+        {**env, WORKER_ENV: "0", "TRLX_TPU_FLEET_LEAVE_AFTER": "2"},
+    )
+    w1 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w1", fleet_dir, 1,
+        {**env, "TRLX_TPU_FAULTS": "worker_join_mid_run@2"},  # auto worker id
+    )
+    logs = {}
+    try:
+        learner = _launch(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 1, env)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w0 = logs["worker0.log"] = _communicate(w0, timeout=300)
+        out_w1 = logs["worker1.log"] = _communicate(w1, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert w0.returncode == 0, f"worker0 failed:\n{out_w0[-4000:]}"
+        assert w1.returncode == 0, f"worker1 failed:\n{out_w1[-4000:]}"
+
+        assert _consumed_units(fleet_dir) == list(range(6))
+        events = _events(fleet_dir)
+        # The leaver: exactly 2 units produced, then a clean deregistration.
+        left = [e for e in events if e["event"] == "worker_left"]
+        assert len(left) == 1
+        assert left[0]["worker"] == 0 and left[0]["produced"] == 2
+        # The joiner: registered mid-run (cursor >= 2), adopted weights.
+        # Publish-before-cursor-advance means cursor 2 implies ordinal 1 is
+        # out, so a bootstrap fetch of ordinal 0 here would prove the joiner
+        # adopted a HISTORICAL broadcast instead of the latest.
+        joins = [e for e in events if e["event"] == "worker_registered" and e["worker"] != 0]
+        assert len(joins) == 1 and joins[0]["joined_at"] == 2 and joins[0]["cursor"] >= 2
+        joiner = joins[0]["worker"]
+        fetched = [e for e in events if e["event"] == "weights_fetched" and e.get("worker") == joiner]
+        assert fetched and fetched[0]["ordinal"] >= 1  # latest, not historical
+        producers = {e["worker"]: 0 for e in events if e["event"] == "episode_consumed"}
+        for e in events:
+            if e["event"] == "episode_consumed":
+                producers[e["worker"]] += 1
+        assert producers[0] == 2 and producers[joiner] == 4
+        # Registry end-state: 0 left, the joiner active until coordinated
+        # shutdown flipped it to left on exit.
+        paths = FleetPaths(root=str(fleet_dir))
+        reg = WorkerRegistry(paths.workers_dir).workers()
+        assert reg[0]["status"] == "left"
+        assert reg[joiner]["status"] == "left"
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] == "complete"
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w0, "worker0")
+        _assert_clean_threads(out_w1, "worker1")
+    finally:
+        for p in (w0, w1):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_fleet_drill_all_workers_dead_degrades_cleanly(tmp_path):
+    """PR 16 contract under elastic triage: the ONLY worker dies holding a
+    lease → the per-worker triage reads dead, the aggregate goes dead, the
+    learner drains what landed, flips fleet/degraded on a LIVE scrape, and
+    exits 0 — never a hang."""
+    fleet_dir = tmp_path / "fleet"
+    env = {"TOTAL": "100", "EPOCHS": "100", "LEASE_TTL": "1.0"}
+    w0 = _launch(
+        tmp_path, "rollout", tmp_path / "ckpt_w0", fleet_dir, 2,
+        {**env, WORKER_ENV: "0", "TRLX_TPU_FAULTS": "worker_kill_mid_lease@1"},
+    )
+    logs = {}
+    try:
+        mport = _free_port()
+        learner = _launch(
+            tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 2,
+            {**env, "TRLX_TPU_METRICS_PORT": str(mport)},
+        )
+        out_l = logs["learner.log"] = _communicate(learner)
+        logs["worker0.log"] = _communicate(w0, timeout=60)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert w0.returncode == 1
+        assert "[fleet] learner stopped cleanly" in out_l
+        events = _events(fleet_dir)
+        degraded = [e for e in events if e["event"] == "degraded"]
+        assert degraded and degraded[0]["triage"] == "dead"
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] in ("degraded", "stream_dry")
+        # Live degraded scrape carries the per-worker verdict.
+        with open(os.path.join(str(tmp_path / "ckpt_l"), "scrape_degraded.json")) as f:
+            scrape = json.load(f)
+        assert scrape["fleet"]["disaggregated"]["state"] == "degraded"
+        assert scrape["fleet"]["workers"]["0"]["state"] == "dead"
+        _assert_clean_threads(out_l, "learner")
+    finally:
+        if w0.poll() is None:
+            w0.kill()
+            w0.communicate()
+        _export_artifacts(fleet_dir, logs)
+
+
+@pytest.mark.slow
+def test_two_worker_staleness0_matches_serial_bitwise(tmp_path):
+    """The N-worker acceptance identity: 2 elastic workers at
+    max_staleness=0 — units lease-serialized across two real processes,
+    episodes crossing as npz, weights crossing back as byte-leaves —
+    reproduce the serial loss trajectory bitwise."""
+    # 3 work units (TOTAL = 4 * EPOCHS), identical for both legs. The TTL
+    # is slack: at staleness 0 the units serialize anyway, and a live worker
+    # losing its lease mid-compile would only add churn, never divergence.
+    env = {"TOTAL": "12", "EPOCHS": "3"}
+    serial = _launch(tmp_path, "serial", tmp_path / "ckpt_s", tmp_path / "unused", 0, env)
+    out_s = _communicate(serial)
+    assert serial.returncode == 0, f"serial run failed:\n{out_s[-4000:]}"
+
+    fleet_dir = tmp_path / "fleet"
+    env = {**env, "LEASE_TTL": "30", "HB_TIMEOUT": "10"}
+    w0 = _launch(tmp_path, "rollout", tmp_path / "ckpt_w0", fleet_dir, 0, {**env, WORKER_ENV: "0"})
+    w1 = _launch(tmp_path, "rollout", tmp_path / "ckpt_w1", fleet_dir, 0, {**env, WORKER_ENV: "1"})
+    logs = {}
+    try:
+        learner = _launch(tmp_path, "learner", tmp_path / "ckpt_l", fleet_dir, 0, env)
+        out_l = logs["learner.log"] = _communicate(learner)
+        out_w0 = logs["worker0.log"] = _communicate(w0, timeout=120)
+        out_w1 = logs["worker1.log"] = _communicate(w1, timeout=120)
+        assert learner.returncode == 0, f"learner failed:\n{out_l[-4000:]}"
+        assert w0.returncode == 0, f"worker0 failed:\n{out_w0[-4000:]}"
+        assert w1.returncode == 0, f"worker1 failed:\n{out_w1[-4000:]}"
+
+        def losses(out):
+            line = next(l for l in out.splitlines() if l.startswith("LOSSES "))
+            return json.loads(line[len("LOSSES "):])
+
+        assert losses(out_s) == losses(out_l)
+        assert len(losses(out_s)) == 12
+
+        consumed = [e for e in _events(fleet_dir) if e["event"] == "episode_consumed"]
+        assert consumed and all(e["staleness"] == 0 for e in consumed)
+        assert [e["unit"] for e in consumed] == list(range(len(consumed)))
+        with open(os.path.join(str(fleet_dir), "abort.json")) as f:
+            assert json.load(f)["reason"] == "complete"
+        _assert_clean_threads(out_l, "learner")
+        _assert_clean_threads(out_w0, "worker0")
+        _assert_clean_threads(out_w1, "worker1")
+    finally:
+        for p in (w0, w1):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        _export_artifacts(fleet_dir, logs)

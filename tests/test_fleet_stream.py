@@ -386,6 +386,80 @@ def test_cursor_torn_read_falls_back_to_last_indexed_seq(tmp_path):
     assert _read_cursor(paths) == 1  # intact cursor wins over the index
 
 
+def test_elastic_cursor_resumes_over_interleaved_stream_files(tmp_path):
+    """Elastic resume (satellite): the unit cursor is the single authority
+    across N interleaved per-worker stream files — an intact cursor wins no
+    matter how units interleave across stream.jsonl / stream.w001.jsonl,
+    and the recovered per-stream marks ride along for forensics."""
+    from trlx_tpu.fleet import ElasticStreamReader
+    from trlx_tpu.fleet.runner import _read_cursor
+
+    paths = FleetPaths(root=str(tmp_path)).ensure_elastic()
+    w0 = EpisodeStreamWriter(paths, worker=0)
+    w1 = EpisodeStreamWriter(paths, worker=1)
+    # Units interleave across the two streams: w0 produces 0 and 2 (its
+    # seqs 0,1), w1 produces 1 and 3 (its seqs 0,1).
+    w0.append(_columns(seed=0), weight_version=0, unit=0)
+    w1.append(_columns(seed=1), weight_version=0, unit=1)
+    w0.append(_columns(seed=2), weight_version=1, unit=2)
+    w1.append(_columns(seed=3), weight_version=1, unit=3)
+    reader = ElasticStreamReader(paths)
+    assert sorted(reader.chosen()) == [0, 1, 2, 3]
+    assert reader.duplicates() == 0
+    # Per-worker seqs restart at 0 in each file; units stay globally unique.
+    assert [r["seq"] for r in reader.indexes()[1]] == [0, 1]
+    with open(paths.cursor, "w") as f:
+        json.dump({"consumed": 3, "ordinal": 2, "streams": {"0": 2, "1": 1}}, f)
+    assert _read_cursor(paths) == 3  # intact cursor wins over every index
+
+
+def test_elastic_cursor_torn_read_falls_back_over_all_stream_files(tmp_path):
+    """Torn elastic cursor with TWO writers: the at-most-once fallback must
+    scan EVERY per-worker index — falling back to worker 0's file alone
+    would re-consume whatever only landed in a peer's stream."""
+    from trlx_tpu.fleet.runner import _read_cursor
+
+    paths = FleetPaths(root=str(tmp_path)).ensure_elastic()
+    w0 = EpisodeStreamWriter(paths, worker=0)
+    w1 = EpisodeStreamWriter(paths, worker=1)
+    w0.append(_columns(seed=0), weight_version=0, unit=0)
+    w1.append(_columns(seed=1), weight_version=0, unit=1)
+    w1.append(_columns(seed=2), weight_version=1, unit=4)  # peer holds the max
+    with open(paths.cursor, "w") as f:
+        f.write('{"consumed": 2, "stre')  # torn write mid-flight
+    assert _read_cursor(paths) == 5  # 1 + max unit across ALL indexes
+    os.remove(paths.cursor)
+    # MISSING (vs torn) keeps the PR 16 fresh-fleet contract: nothing was
+    # ever consumed, so 0 — only a PRESENT-but-garbage cursor scans.
+    assert _read_cursor(paths) == 0
+
+
+def test_elastic_reader_dedupes_reclaim_races_by_unit(tmp_path):
+    """Two records for one unit (a reclaimer racing its slow original
+    owner): chosen() keeps the first to land, duplicates() counts the
+    loser, and both productions carry the same prompt-shard episode_key."""
+    from trlx_tpu.fleet import ElasticStreamReader, episode_key
+
+    paths = FleetPaths(root=str(tmp_path)).ensure_elastic()
+    cols = _columns(seed=7)
+    w0 = EpisodeStreamWriter(paths, worker=0)
+    w1 = EpisodeStreamWriter(paths, worker=1)
+    w1.append(cols, weight_version=0, unit=0)  # reclaimer lands first
+    time.sleep(0.01)
+    w0.append(cols, weight_version=1, unit=0)  # slow owner lands late
+    reader = ElasticStreamReader(paths)
+    assert reader.duplicates() == 1
+    chosen = reader.chosen()[0]
+    assert chosen["worker"] == 1
+    records = reader.by_unit()[0]
+    # Same deterministic prompt shard → same content key on BOTH records,
+    # even though a weight version landed between the two productions.
+    assert {r["episode_key"] for r in records} == {episode_key(cols)}
+    # The npz the learner loads is the chosen record's, bitwise.
+    got = reader.load(chosen)
+    assert all(np.array_equal(got[k], cols[k]) for k in cols)
+
+
 def test_put_leaves_names_first_dtype_mismatched_leaf(tmp_path):
     """Satellite: a same-shape dtype misconfig (f32 learner streaming to a
     bf16 rollout world) must fail NAMING the first mismatched leaf path,

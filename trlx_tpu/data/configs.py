@@ -217,6 +217,12 @@ class TrainConfig:
     # on waiting for a weight broadcast the staleness gate requires
     # (0 = train.collective_deadline, else 60s).
     fleet_broadcast_deadline: float = 0.0
+    # Elastic fleet (method.fleet_elastic): seconds a claimed work-unit
+    # lease stays valid without a renewal before any peer may reclaim the
+    # unit (0 = max(6x heartbeat_interval, 3s)). Renewals ride the
+    # producer's progress heartbeat; drills shrink this to ~1s so a
+    # reclaim fits the test budget.
+    fleet_lease_ttl: float = 0.0
 
     # --- observability (trlx_tpu/observability/) ---
     # Cross-thread span tracing: host-side spans from the train loop, the

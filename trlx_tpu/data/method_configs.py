@@ -157,6 +157,19 @@ class PPOConfig(MethodConfig):
     # silently inert on the chunked path. Off (default) keeps the PR 16
     # phase-boundary adoption byte-identical.
     fleet_inflight_weights: bool = False
+    # fleet_elastic: N-worker elastic fleet. Work is partitioned into
+    # prompt-shard WORK UNITS (unit u = train iteration u's deterministic
+    # prompt chunks); rollout workers claim units through the atomic lease
+    # ledger (<fleet_dir>/leases, O_EXCL generation files with
+    # heartbeat-renewed expiry), each streams into its OWN index
+    # (stream.w<k>.jsonl), and the learner's intake dedupes by
+    # (work_unit, episode_key) so a reclaimed unit's double-production is
+    # consumed exactly once. Workers may join mid-run (register, adopt the
+    # latest broadcast, start claiming) and leave cleanly (deregister); a
+    # dead worker's leases expire and peers reclaim them. Requires
+    # fleet_disaggregate. Off (default) keeps the single-worker PR 16/17
+    # stream layout byte-identical.
+    fleet_elastic: bool = False
 
 
 @dataclass

@@ -7,11 +7,25 @@ and per-role heartbeats driving a degradation ladder (runner.py). Armed by
 ``method.fleet_disaggregate``; per-process role from ``TRLX_TPU_FLEET_ROLE``
 or ``train.fleet_role``; no role = colocated single-process mode, the
 bitwise staleness-0 parity configuration (tests/test_fleet_disagg.py).
+
+``method.fleet_elastic`` generalizes the rollout side to N workers: work is
+partitioned into prompt-shard WORK UNITS claimed through an atomic lease
+ledger (leases.py), each worker appends to its own stream index, and the
+learner's exactly-once intake (stream.ElasticStreamReader) dedupes reclaim
+races by (work_unit, episode_key). Membership is dynamic — mid-run join,
+clean leave, and kill are first-class (tests/test_fleet_elastic.py).
 """
 
 from .broadcast import WeightPublisher, WeightSubscriber, put_leaves
+from .leases import Lease, LeaseLedger, WorkerRegistry
 from .runner import FleetDegradedExit, FleetLearnerFeed, fleet_snapshot, run_rollout_worker
-from .stream import EpisodeStreamReader, EpisodeStreamTimeout, EpisodeStreamWriter
+from .stream import (
+    ElasticStreamReader,
+    EpisodeStreamReader,
+    EpisodeStreamTimeout,
+    EpisodeStreamWriter,
+    episode_key,
+)
 from .topology import (
     FLEET_TRAIN_KNOBS,
     LEARNER_HOST,
@@ -20,6 +34,7 @@ from .topology import (
     ROLE_LEARNER,
     ROLE_ROLLOUT,
     ROLLOUT_HOST,
+    WORKER_ENV,
     FleetPaths,
     fleet_paths,
     resolve_role,
@@ -28,6 +43,7 @@ from .topology import (
 )
 
 __all__ = [
+    "ElasticStreamReader",
     "EpisodeStreamReader",
     "EpisodeStreamTimeout",
     "EpisodeStreamWriter",
@@ -36,13 +52,18 @@ __all__ = [
     "FleetLearnerFeed",
     "FleetPaths",
     "LEARNER_HOST",
+    "Lease",
+    "LeaseLedger",
     "ROLE_COLOCATED",
     "ROLE_ENV",
     "ROLE_LEARNER",
     "ROLE_ROLLOUT",
     "ROLLOUT_HOST",
+    "WORKER_ENV",
     "WeightPublisher",
     "WeightSubscriber",
+    "WorkerRegistry",
+    "episode_key",
     "fleet_paths",
     "fleet_snapshot",
     "put_leaves",

@@ -47,12 +47,19 @@ from trlx_tpu.resilience.distributed import Heartbeat, read_heartbeats
 from trlx_tpu.utils.jsonl import append_record
 
 from .broadcast import WeightPublisher, WeightSubscriber, put_leaves
-from .stream import EpisodeStreamReader, EpisodeStreamWriter, EpisodeStreamTimeout
+from .leases import LeaseLedger, WorkerRegistry
+from .stream import (
+    ElasticStreamReader,
+    EpisodeStreamReader,
+    EpisodeStreamTimeout,
+    EpisodeStreamWriter,
+)
 from .topology import (
     LEARNER_HOST,
     ROLE_COLOCATED,
     ROLE_ROLLOUT,
     ROLLOUT_HOST,
+    WORKER_ENV,
     FleetPaths,
     fleet_paths,
     read_jsonl_or_empty,
@@ -125,8 +132,15 @@ def _read_cursor(paths: FleetPaths) -> int:
     try:
         return int(json.loads(raw)["consumed"])
     except (ValueError, KeyError, TypeError):
-        records = read_jsonl_or_empty(paths.stream_index)
-        return 1 + max((int(r["seq"]) for r in records), default=-1)
+        # Scan EVERY stream index (an elastic fleet interleaves N of them;
+        # the single-worker fleet has exactly one) and skip past the highest
+        # landed unit/seq — the at-most-once verdict must cover batches a
+        # peer streamed while this cursor was being torn.
+        best = -1
+        for index_path in paths.stream_indexes().values() or [paths.stream_index]:
+            for r in read_jsonl_or_empty(index_path):
+                best = max(best, int(r.get("unit", r["seq"])))
+        return 1 + best
 
 
 def _event(paths: FleetPaths, role: str, event: str, **fields):
@@ -146,7 +160,12 @@ def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
     ``rollout`` (trainer/api.py). Exits 0 on ``abort.json`` (coordinated
     shutdown), 117 via the collective guard if the broadcast starves past
     ``fleet_broadcast_deadline``, and abruptly (``os._exit(1)``) on the
-    ``rollout_host_kill`` fault."""
+    ``rollout_host_kill`` fault.
+
+    With ``method.fleet_elastic`` the worker instead joins the N-worker
+    lease-claiming loop (``_run_elastic_worker``)."""
+    if getattr(trainer.config.method, "fleet_elastic", False):
+        return _run_elastic_worker(trainer, orch, num_rollouts)
     t = trainer.config.train
     knobs = role_timeouts(t)
     paths = fleet_paths(t).ensure()
@@ -321,6 +340,222 @@ def run_rollout_worker(trainer, orch, num_rollouts: Optional[int] = None):
             trainer.heartbeat.stop()
 
 
+# --------------------------------------------------------- elastic worker
+
+
+def _run_elastic_worker(trainer, orch, num_rollouts: Optional[int] = None):
+    """One of N elastic rollout workers: register, then loop claim-a-unit →
+    hold-eligible-weights → seek-the-unit's-prompt-shard → produce →
+    stream → complete, with the lease renewed off the produce heartbeat.
+
+    Membership dynamics handled here: ``worker_join_mid_run@N`` defers
+    registration until the learner's cursor reaches N (the joiner then
+    adopts the LATEST broadcast, never a historical one);
+    ``TRLX_TPU_FLEET_LEAVE_AFTER=k`` makes the worker deregister cleanly
+    after producing k units (releasing any held lease for instant
+    reclaim); ``worker_kill_mid_lease`` / ``slow_worker_reclaim`` die or
+    oversleep while HOLDING a lease, which is exactly what the peers'
+    reclaim path and the learner's dedup intake must absorb."""
+    t = trainer.config.train
+    knobs = role_timeouts(t)
+    paths = fleet_paths(t).ensure_elastic()
+    S = trainer.max_staleness
+    n_roll = int(num_rollouts or trainer.config.method.num_rollouts)
+    plan = trainer.fault_plan
+    cpu = orch.chunks_per_unit(n_roll)
+
+    def aborted() -> bool:
+        return paths.read_abort() is not None
+
+    # Dynamic join: hold registration (and the heartbeat — an unregistered
+    # worker must not look like a dead one) until the run reaches the
+    # configured cursor.
+    join_at = plan.pending_at("worker_join_mid_run")
+    if join_at is not None:
+        while _read_cursor(paths) < join_at and not aborted():
+            time.sleep(0.05)
+        plan.fire_at_or_after("worker_join_mid_run", join_at)
+        if aborted():
+            return
+
+    registry = WorkerRegistry(paths.workers_dir)
+    ledger = LeaseLedger(paths.leases_dir, ttl=knobs["lease_ttl"])
+    env_worker = os.environ.get(WORKER_ENV, "")
+    wid = registry.register(int(env_worker) if env_worker else None)
+    heartbeat = Heartbeat(
+        paths.heartbeats_dir, knobs["heartbeat_interval"],
+        process_index=ROLLOUT_HOST + wid,
+    )
+    heartbeat.start()
+    writer = EpisodeStreamWriter(paths, fault_plan=plan, worker=wid)
+    subscriber = WeightSubscriber(paths)
+    _event(
+        paths, ROLE_ROLLOUT, "worker_registered",
+        worker=wid, cursor=_read_cursor(paths),
+        **({"joined_at": join_at} if join_at is not None else {}),
+    )
+    leave_after = int(os.environ.get("TRLX_TPU_FLEET_LEAVE_AFTER", "0") or 0)
+    produced = 0
+    current_ordinal = -1
+    snapshot = None
+    lease = None
+    reason = "abort"
+    try:
+        # Bootstrap: hold SOME broadcast before claiming anything. A lease
+        # claimed across the learner's first publish (compile + first step,
+        # easily many TTLs) would expire un-renewed and spawn spurious
+        # bootstrap reclaims among the very workers that are all just
+        # waiting. A mid-run joiner gets the LATEST ordinal here — never a
+        # historical one (broadcast.py serves the freshest >= need).
+        heartbeat.beat(step=0, phase="fleet:wait_weights")
+        boot = subscriber.fetch(
+            0,
+            deadline=knobs["broadcast_deadline"],
+            abort_check=aborted,
+            heartbeat=heartbeat,
+        )
+        if boot is not None:
+            latest, leaves = boot
+            snapshot = fleet_snapshot(trainer, leaves, latest["version"])
+            current_ordinal = int(latest["ordinal"])
+            if "kl_coef" in latest and getattr(trainer, "kl_ctl", None) is not None:
+                trainer.kl_ctl.value = float(latest["kl_coef"])
+            _event(
+                paths, ROLE_ROLLOUT, "weights_fetched",
+                ordinal=current_ordinal, version=snapshot["version"], worker=wid,
+            )
+        while boot is not None and not aborted():
+            if leave_after and produced >= leave_after:
+                reason = "left"
+                break
+            consumed = _read_cursor(paths)
+            heartbeat.beat(step=consumed, phase="fleet:claim")
+            # Lowest claimable gate-open unit: [cursor, cursor+S] are the
+            # only units the staleness gate admits; done/fresh-held units
+            # are skipped inside try_claim.
+            lease = None
+            for unit in range(consumed, consumed + S + 1):
+                got = ledger.try_claim(unit, wid)
+                if got is not None:
+                    lease = got
+                    break
+            if lease is None:
+                time.sleep(0.05)
+                continue
+            unit = lease.unit
+            _event(
+                paths, ROLE_ROLLOUT,
+                "lease_reclaimed" if lease.gen > 0 else "lease_claimed",
+                unit=unit, worker=wid, gen=lease.gen,
+            )
+            if plan.fire_at_or_after("worker_kill_mid_lease", unit):
+                os._exit(1)  # lease held, nothing streamed: peers must reclaim
+            if plan.fire_at_or_after("slow_worker_reclaim", unit):
+                # Outlive the TTL mid-hold, then produce anyway: the
+                # double-production the learner's dedup must suppress.
+                time.sleep(float(
+                    os.environ.get("TRLX_TPU_SLOW_WORKER_SECONDS", "")
+                    or 3.0 * knobs["lease_ttl"]
+                ))
+            # Weight eligibility for this unit (same gate as single-worker).
+            need = max(0, unit - S)
+            latest = subscriber.latest()
+            leaves = None
+            if latest is None or int(latest["ordinal"]) < need:
+                heartbeat.beat(step=unit, phase="fleet:wait_weights")
+                got = subscriber.fetch(
+                    need,
+                    deadline=knobs["broadcast_deadline"],
+                    abort_check=aborted,
+                    heartbeat=heartbeat,
+                )
+                if got is None:
+                    break  # coordinated shutdown while waiting
+                latest, leaves = got
+            elif int(latest["ordinal"]) != current_ordinal:
+                leaves = subscriber.try_load(latest)
+                if leaves is None and (current_ordinal < need or snapshot is None):
+                    # Torn pointer and the held version is ineligible: spin
+                    # until the next intact ordinal (lease stays renewed via
+                    # the next loop's claim adoption).
+                    ledger.renew(lease)
+                    time.sleep(0.05)
+                    continue
+            if leaves is not None:
+                snapshot = fleet_snapshot(trainer, leaves, latest["version"])
+                current_ordinal = int(latest["ordinal"])
+                if "kl_coef" in latest and getattr(trainer, "kl_ctl", None) is not None:
+                    trainer.kl_ctl.value = float(latest["kl_coef"])
+                _event(
+                    paths, ROLE_ROLLOUT, "weights_fetched",
+                    ordinal=current_ordinal, version=snapshot["version"],
+                    unit=unit, worker=wid,
+                )
+
+            # The unit's prompt shard: every worker derives the same
+            # deterministic chunk schedule, so a reclaimed unit reproduces
+            # the dead owner's exact prompts.
+            orch.seek_chunks(unit * cpu)
+            store = PPORolloutStorage(trainer.pad_token_id, record_staleness=True)
+            renew_state = {"last": time.monotonic(), "owned": True}
+
+            def produce_stop():
+                heartbeat.beat(step=unit, phase="fleet:produce")
+                now = time.monotonic()
+                if renew_state["owned"] and now - renew_state["last"] >= max(
+                    0.2, knobs["lease_ttl"] / 3.0
+                ):
+                    renew_state["last"] = now
+                    if ledger.renew(lease) is None:
+                        # A peer reclaimed us mid-produce. Keep producing —
+                        # the intake dedupes, and aborting would strand a
+                        # dispatched phase — but say so once.
+                        renew_state["owned"] = False
+                        _event(
+                            paths, ROLE_ROLLOUT, "lease_lost",
+                            unit=unit, worker=wid, gen=lease.gen,
+                        )
+                return aborted()
+
+            info = orch.make_experience(
+                n_roll,
+                iter_count=snapshot["version"],
+                store=store,
+                snapshot=snapshot,
+                staleness=0,  # realized staleness stamped at consume time
+                stop=produce_stop,
+                weight_poll=None,
+            )
+            del info  # in-flight spans are a single-worker engine contract
+            if aborted():
+                break  # phase cut short; drop the partial store
+            heartbeat.beat(step=unit, phase="fleet:stream")
+            seq = writer.append(
+                store.columns(), weight_version=snapshot["version"], unit=unit
+            )
+            kept = ledger.complete(lease)
+            produced += 1
+            _event(
+                paths, ROLE_ROLLOUT, "episode_streamed",
+                unit=unit, seq=seq, version=snapshot["version"], n=len(store),
+                worker=wid, lease_kept=bool(kept),
+            )
+            lease = None
+        _event(
+            paths, ROLE_ROLLOUT, "worker_exit",
+            reason=reason, worker=wid, produced=produced,
+        )
+        if reason == "left":
+            _event(paths, ROLE_ROLLOUT, "worker_left", worker=wid, produced=produced)
+    finally:
+        if lease is not None:
+            ledger.release(lease)
+        registry.leave(wid)
+        heartbeat.stop()
+        if getattr(trainer, "heartbeat", None) is not None:
+            trainer.heartbeat.stop()
+
+
 # ----------------------------------------------------------- learner feed
 
 
@@ -340,8 +575,22 @@ class FleetLearnerFeed:
         self.role = trainer.fleet_role
         self.max_staleness = trainer.max_staleness
         self.knobs = role_timeouts(t)
-        self.paths = fleet_paths(t).ensure()
-        self.reader = EpisodeStreamReader(self.paths)
+        self.elastic = bool(getattr(trainer.config.method, "fleet_elastic", False))
+        self.paths = (
+            fleet_paths(t).ensure_elastic() if self.elastic else fleet_paths(t).ensure()
+        )
+        # Elastic: exactly-once unit intake across N per-worker indexes
+        # (reclaim duplicates counted + suppressed); else the PR 16
+        # single-stream sequential reader. Same wait/queued_from/load shape.
+        self.reader = (
+            ElasticStreamReader(self.paths) if self.elastic else EpisodeStreamReader(self.paths)
+        )
+        self._registry = WorkerRegistry(self.paths.workers_dir) if self.elastic else None
+        self._ledger = (
+            LeaseLedger(self.paths.leases_dir, ttl=self.knobs["lease_ttl"])
+            if self.elastic
+            else None
+        )
         self.publisher = WeightPublisher(self.paths, fault_plan=trainer.fault_plan)
         # version -> publish ordinal, for realized-staleness stamping
         # (resume-aware: rebuilt from the log, injected entries included —
@@ -350,6 +599,19 @@ class FleetLearnerFeed:
             int(r["version"]): int(r["ordinal"]) for r in read_jsonl_or_empty(self.paths.broadcast_log)
         }
         self.consumed = _read_cursor(self.paths)
+        # Elastic resume: recover the per-stream consume marks alongside the
+        # unit cursor (absent/torn cursors leave it empty — marks are
+        # forensic, the unit cursor is the authority).
+        self._stream_marks = {}
+        if self.elastic:
+            import json
+
+            try:
+                with open(self.paths.cursor, "r") as f:
+                    marks = json.load(f).get("streams") or {}
+                self._stream_marks = {str(k): int(v) for k, v in marks.items()}
+            except (OSError, ValueError, TypeError, AttributeError):
+                pass
         self.state = "ok"
         self.triage = ""
         self._abort_written = False
@@ -363,6 +625,11 @@ class FleetLearnerFeed:
         self._subscriber = WeightSubscriber(self.paths) if self.role == ROLE_COLOCATED else None
         self._colo_ordinal = -1
         self._colo_snapshot = None
+        if self.elastic and self._writer is not None:
+            # Colocated elastic: the inline producer IS worker 0 — it
+            # registers, claims leases, and tags units like any peer, so
+            # the fast parity tests drive the whole elastic machinery.
+            self._registry.register(0)
         # Token-granularity staleness watch (in-flight weight updates): the
         # detector rides the trainer's health monitor when one is armed —
         # its state joins the health/* gauges and a CRIT escalates through
@@ -441,6 +708,11 @@ class FleetLearnerFeed:
 
     def _consume(self, rec: dict) -> PPORolloutStorage:
         seq = int(rec["seq"])
+        # Elastic records advance the cursor by WORK UNIT (the per-worker
+        # seq only orders one stream); the single-worker stream's seq IS
+        # its unit.
+        unit = int(rec.get("unit", rec["seq"]))
+        worker = int(rec.get("worker", 0))
         version = int(rec["weight_version"])
         latest_ordinal = self.publisher.next_ordinal - 1
         v_ordinal = self._version_ordinal.get(version)
@@ -491,20 +763,29 @@ class FleetLearnerFeed:
         cols["staleness"] = np.full((n, 1), float(staleness), dtype=np.float32)
         store = PPORolloutStorage(self.trainer.pad_token_id, record_staleness=True)
         store.push_batch(cols)
-        self.consumed = seq + 1
-        atomic_write_json(
-            self.paths.cursor,
-            {"consumed": self.consumed, "ordinal": latest_ordinal, "t": time.time()},
-        )
+        self.consumed = unit + 1
+        cursor_payload = {
+            "consumed": self.consumed, "ordinal": latest_ordinal, "t": time.time(),
+        }
+        if self.elastic:
+            # Per-stream consume marks: which seq of each worker's index the
+            # chosen records have reached — the resume forensics for
+            # interleaved multi-stream cursors (consumed alone is the
+            # authority; units are strictly ordered).
+            self._stream_marks[str(worker)] = seq + 1
+            cursor_payload["streams"] = dict(self._stream_marks)
+        atomic_write_json(self.paths.cursor, cursor_payload)
         _event(
             self.paths, self.role, "episode_consumed",
             seq=seq, version=version, staleness=staleness, n=n, state=self.state,
+            **({"unit": unit, "worker": worker} if self.elastic else {}),
             **({"mixed_version_tokens": mixed_tokens} if spans else {}),
         )
         self._export(
             staleness=float(staleness),
             version=version,
             mixed_tokens=float(mixed_tokens) if spans else None,
+            worker=worker if self.elastic else None,
         )
         return store
 
@@ -512,10 +793,33 @@ class FleetLearnerFeed:
 
     def _inline_produce(self):
         """Colocated mode: run the worker's loop body inline until the gate
-        closes — same transports, same schedule, one process."""
+        closes — same transports, same schedule, one process. With
+        ``method.fleet_elastic`` the inline producer is WORKER 0: it claims
+        each unit's lease, seeks the unit's prompt shard, and tags its
+        records — the fast (in-process) path through the whole elastic
+        machinery, which the parity tests pin against the non-elastic
+        colocated run bitwise."""
         tr = self.trainer
+        cpu = (
+            self.orch.chunks_per_unit(tr.config.method.num_rollouts)
+            if self.elastic
+            else 0
+        )
         while staleness_gate_open(self._writer.next_seq, self.consumed, self.max_staleness):
             seq = self._writer.next_seq
+            lease = None
+            if self.elastic:
+                lease = self._ledger.try_claim(seq, 0)
+                if lease is None:
+                    # Unit already done (produced before a learner restart):
+                    # nothing to produce until consuming reopens the gate.
+                    break
+                _event(
+                    self.paths, self.role,
+                    "lease_reclaimed" if lease.gen > 0 else "lease_claimed",
+                    unit=seq, worker=0, gen=lease.gen,
+                )
+                self.orch.seek_chunks(seq * cpu)
             latest = self._subscriber.latest()
             if latest is None or int(latest["ordinal"]) < max(0, seq - self.max_staleness):
                 raise RuntimeError(
@@ -550,22 +854,22 @@ class FleetLearnerFeed:
                     if inflight and isinstance(info, dict)
                     else None
                 ),
+                unit=seq if self.elastic else None,
             )
+            if lease is not None:
+                self._ledger.complete(lease)
             _event(
                 self.paths, self.role, "episode_streamed",
                 seq=seq, version=self._colo_snapshot["version"], n=len(store),
+                **({"unit": seq, "worker": 0} if self.elastic else {}),
             )
 
     # --------------------------------------------------------- degradation
 
-    def _triage_rollout(self) -> str:
-        """Classify the rollout role from its fleet heartbeat: 'dead'
-        (written_t stale — process gone), 'stalled' (file fresh, progress_t
-        frozen — thread alive, work wedged), 'alive' (progressing), or
-        'starting' (no heartbeat yet, within the startup grace)."""
+    def _classify_heartbeat(self, rec) -> str:
+        """dead / stalled / alive / starting from one heartbeat record —
+        the same written_t-vs-progress_t distinction for every role."""
         timeout = self.knobs["heartbeat_timeout"]
-        recs = read_heartbeats(self.paths.heartbeats_dir)
-        rec = recs.get(ROLLOUT_HOST)
         now = time.time()
         if rec is None:
             grace = max(120.0, 10.0 * timeout)
@@ -575,6 +879,61 @@ class FleetLearnerFeed:
         if now - float(rec.get("progress_t", 0.0)) > timeout:
             return "stalled"
         return "alive"
+
+    def _triage_workers(self) -> dict:
+        """Per-worker triage (elastic only): worker id -> {state,
+        heartbeat_age, leases_held, incarnation}. A worker that wrote a
+        clean ``left`` record is 'left' regardless of heartbeat age — a
+        deregistered exit is not a fault. Heartbeats live at process index
+        ROLLOUT_HOST + worker id."""
+        recs = read_heartbeats(self.paths.heartbeats_dir)
+        now = time.time()
+        workers = {}
+        for wid, wrec in sorted(self._registry.workers().items()):
+            hb = recs.get(ROLLOUT_HOST + wid)
+            if wrec.get("status") == "left":
+                state = "left"
+            else:
+                state = self._classify_heartbeat(hb)
+            workers[wid] = {
+                "state": state,
+                "heartbeat_age": (
+                    round(now - float(hb.get("written_t", 0.0)), 3) if hb else None
+                ),
+                "leases_held": len(self._ledger.held_by(wid)),
+                "incarnation": int(wrec.get("incarnation", 0)),
+            }
+        return workers
+
+    def _triage_rollout(self) -> str:
+        """Classify the rollout side from its fleet heartbeat(s): 'dead'
+        (written_t stale — process gone), 'stalled' (file fresh, progress_t
+        frozen — thread alive, work wedged), 'alive' (progressing), or
+        'starting' (no heartbeat yet, within the startup grace).
+
+        Elastic aggregate across the registry: ANY progressing worker keeps
+        the fleet alive (a dead peer's units get reclaimed — not a fault),
+        any still-compiling worker keeps it starting, a wedged-but-present
+        worker reads stalled, and only an EMPTY set of live workers is dead
+        — which degrades gracefully per the PR 16 contract."""
+        recs = read_heartbeats(self.paths.heartbeats_dir)
+        if not self.elastic:
+            return self._classify_heartbeat(recs.get(ROLLOUT_HOST))
+        states = [
+            w["state"] for w in self._triage_workers().values() if w["state"] != "left"
+        ]
+        if any(s == "alive" for s in states):
+            return "alive"
+        if any(s == "starting" for s in states):
+            return "starting"
+        if any(s == "stalled" for s in states):
+            return "stalled"
+        if states:
+            return "dead"
+        # Empty registry: nobody ever joined (startup grace) or everyone
+        # left cleanly and no one remains to produce.
+        grace = max(120.0, 10.0 * self.knobs["heartbeat_timeout"])
+        return "starting" if time.monotonic() - self._t0 < grace else "dead"
 
     def _enter_degraded(self, triage: str):
         if self.state == "degraded":
@@ -620,7 +979,7 @@ class FleetLearnerFeed:
 
     # --------------------------------------------------------- observability
 
-    def _export(self, staleness=None, version=None, mixed_tokens=None):
+    def _export(self, staleness=None, version=None, mixed_tokens=None, worker=None):
         exporter = getattr(self.trainer, "_metrics_exporter", None)
         payload = {
             "state": self.state,
@@ -641,5 +1000,37 @@ class FleetLearnerFeed:
             # Tokens in the last consumed batch NOT produced by its freshest
             # weight version — the in-flight update mix the detector watches.
             gauges["fleet/mixed_version_tokens"] = float(mixed_tokens)
+        fleet_payload = {"disaggregated": payload}
+        if self.elastic:
+            workers = self._triage_workers()
+            gauges["fleet/episodes_deduped_total"] = float(self.reader.duplicates())
+            gauges["fleet/units_reclaimed_total"] = float(
+                len(self._ledger.reclaimed_units())
+            )
+            gauges["fleet/workers_active"] = float(
+                sum(1 for w in workers.values() if w["state"] in ("alive", "starting"))
+            )
+            # Every fleet/* per-consume gauge carries the producing worker as
+            # a label; the per-worker liveness trio is labeled by triaged id.
+            if worker is not None:
+                labels = {"worker": str(int(worker))}
+                if staleness is not None:
+                    exporter.set_gauge("fleet/staleness", float(staleness), labels)
+                if version is not None:
+                    exporter.set_gauge("fleet/weight_version", float(version), labels)
+            state_code = {"alive": 0, "starting": 1, "stalled": 2, "dead": 3, "left": 4}
+            for wid, w in workers.items():
+                labels = {"worker": str(wid)}
+                if w["heartbeat_age"] is not None:
+                    exporter.set_gauge(
+                        "fleet/worker_heartbeat_age", float(w["heartbeat_age"]), labels
+                    )
+                exporter.set_gauge(
+                    "fleet/worker_leases_held", float(w["leases_held"]), labels
+                )
+                exporter.set_gauge(
+                    "fleet/worker_state", float(state_code.get(w["state"], 3)), labels
+                )
+            fleet_payload["workers"] = {str(k): v for k, v in workers.items()}
         exporter.update(gauges)
-        exporter.set_fleet({"disaggregated": payload})
+        exporter.set_fleet(fleet_payload)

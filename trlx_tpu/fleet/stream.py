@@ -28,7 +28,8 @@ signature the learner's triage must classify as STALLED (not DEAD).
 
 import os
 import time
-from typing import Dict, Optional
+import zlib
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -41,6 +42,23 @@ from .topology import FleetPaths, read_jsonl_or_empty
 class EpisodeStreamTimeout(RuntimeError):
     """A stream wait exhausted its per-attempt timeout (retryable; the
     caller's retry wrapper decides when it becomes a triage event)."""
+
+
+def episode_key(columns: Dict[str, np.ndarray]) -> str:
+    """Content key for a streamed batch's PROMPT shard: crc32 over the
+    query tokens+mask bytes. Two productions of the same work unit — the
+    original owner's and a reclaimer's — decode the same deterministic
+    prompt chunks, so they carry the SAME key even when a weight broadcast
+    landed between them (responses differ, queries cannot). The elastic
+    intake dedupes on (work_unit, episode_key); a key mismatch inside one
+    unit means the prompt-shard schedule diverged between workers and is
+    surfaced as a lineage violation, never consumed silently."""
+    crc = 0
+    for name in ("query_tensors", "query_mask"):
+        arr = columns.get(name)
+        if arr is not None:
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return f"{crc:08x}"
 
 
 def _atomic_savez(path: str, columns: Dict[str, np.ndarray]):
@@ -58,12 +76,19 @@ def load_columns(path: str) -> Dict[str, np.ndarray]:
 
 class EpisodeStreamWriter:
     """Rollout-side appender. Resume-aware: a restarted worker continues
-    ``seq`` numbering from the existing index instead of clobbering it."""
+    ``seq`` numbering from the existing index instead of clobbering it.
 
-    def __init__(self, paths: FleetPaths, fault_plan=None):
+    Elastic fleets give every worker its OWN writer (``worker=k`` →
+    ``stream.w<k>.jsonl`` + ``w<k>_``-prefixed npz names) so N producers
+    never contend on an append; worker 0 (and the single-worker fleet)
+    keeps the PR 16/17 file names byte-identically."""
+
+    def __init__(self, paths: FleetPaths, fault_plan=None, worker: int = 0):
         self.paths = paths
         self.fault_plan = fault_plan
-        records = read_jsonl_or_empty(paths.stream_index)
+        self.worker = int(worker)
+        self.index_path = paths.stream_index_for(self.worker)
+        records = read_jsonl_or_empty(self.index_path)
         self.next_seq = 1 + max((int(r["seq"]) for r in records), default=-1)
 
     def append(
@@ -71,6 +96,7 @@ class EpisodeStreamWriter:
         columns: Dict[str, np.ndarray],
         weight_version: int,
         version_spans: Optional[list] = None,
+        unit: Optional[int] = None,
     ) -> int:
         """Write one episode batch atomically and index it. Returns seq.
 
@@ -79,13 +105,18 @@ class EpisodeStreamWriter:
         episodes (engine in-flight updates; Episode.version_spans). Omitted
         (None) for phase-boundary batches, where ``weight_version`` alone
         says everything: the index record stays byte-identical to PR 16's
-        on that path."""
+        on that path.
+
+        ``unit`` (elastic fleet only) tags the record with the WORK UNIT it
+        produces — the learner's exactly-once intake keys on it (plus the
+        content ``episode_key``) across all per-worker indexes. None keeps
+        the single-worker record shape."""
         seq = self.next_seq
         if self.fault_plan is not None and self.fault_plan.fire("episode_stream_stall", seq):
             # Stall INSTEAD of writing: the batch never lands, but the
             # worker process (and its heartbeat thread) stays alive.
             time.sleep(float(os.environ.get("TRLX_TPU_STREAM_STALL_SECONDS", "3600")))
-        path = self.paths.episode_file(seq)
+        path = self.paths.episode_file(seq, worker=self.worker)
         _atomic_savez(path, columns)
         n = int(next(iter(columns.values())).shape[0]) if columns else 0
         rec = {
@@ -97,7 +128,11 @@ class EpisodeStreamWriter:
         }
         if version_spans:
             rec["version_spans"] = [[int(v), int(k)] for v, k in version_spans]
-        append_record(self.paths.stream_index, rec)
+        if unit is not None:
+            rec["unit"] = int(unit)
+            rec["worker"] = self.worker
+            rec["episode_key"] = episode_key(columns)
+        append_record(self.index_path, rec)
         self.next_seq = seq + 1
         return seq
 
@@ -155,4 +190,102 @@ class EpisodeStreamReader:
             backoff=max(0.0, float(backoff)),
             timeout=0.0,  # the attempt bounds itself; no watchdog thread
             description=f"episode stream wait seq={seq}",
+        )
+
+
+class ElasticStreamReader:
+    """Exactly-once learner intake over N per-worker stream indexes.
+
+    The elastic learner consumes WORK UNITS in order (unit u = train
+    iteration u; the train schedule stays deterministic no matter which
+    worker produced which unit). Each scan re-globs ``stream*.jsonl`` —
+    workers join mid-run — and merges every index into a per-unit record
+    list. The CHOSEN record for a unit is the first to land (earliest index
+    timestamp, worker id as the tiebreak); every other record for that unit
+    is a duplicate from a lease reclaim racing its slow/dead original owner
+    and is counted, never consumed — (work_unit, episode_key) dedup, since
+    all of a unit's productions carry the prompt-shard content key. The
+    same API shape as EpisodeStreamReader (wait/poll/queued_from/load), with
+    units in place of seqs, so the learner feed drives either transport."""
+
+    def __init__(self, paths: FleetPaths):
+        self.paths = paths
+
+    def indexes(self) -> Dict[int, List[dict]]:
+        return {
+            worker: read_jsonl_or_empty(path)
+            for worker, path in sorted(self.paths.stream_indexes().items())
+        }
+
+    def by_unit(self) -> Dict[int, List[dict]]:
+        """unit -> its records across all workers, landing order. Records
+        without a ``unit`` field (a non-elastic writer sharing the dir)
+        key on their seq — the N=1 degenerate case."""
+        units: Dict[int, List[dict]] = {}
+        for worker, records in self.indexes().items():
+            for rec in records:
+                rec = dict(rec)
+                rec.setdefault("worker", worker)
+                unit = int(rec.get("unit", rec["seq"]))
+                rec["unit"] = unit
+                units.setdefault(unit, []).append(rec)
+        for recs in units.values():
+            recs.sort(key=lambda r: (float(r.get("t", 0.0)), int(r["worker"])))
+        return units
+
+    def chosen(self) -> Dict[int, dict]:
+        return {unit: recs[0] for unit, recs in self.by_unit().items()}
+
+    def duplicates(self) -> int:
+        """Total landed-but-not-chosen records — the monotone
+        ``fleet/episodes_deduped_total`` counter (index files only append,
+        so rescanning never decreases it)."""
+        return sum(len(recs) - 1 for recs in self.by_unit().values())
+
+    def max_unit(self) -> int:
+        """Highest unit with any landed record, or -1 — the torn-cursor
+        at-most-once fallback's scan (runner._read_cursor)."""
+        return max(self.by_unit().keys(), default=-1)
+
+    def poll(self, unit: int) -> Optional[dict]:
+        return self.chosen().get(int(unit))
+
+    def queued_from(self, unit: int) -> list:
+        """Chosen records for every landed unit >= the cursor — the
+        degraded-drain worklist (duplicates never drain twice)."""
+        return [r for u, r in sorted(self.chosen().items()) if u >= int(unit)]
+
+    def load(self, record: dict) -> Dict[str, np.ndarray]:
+        return load_columns(os.path.join(self.paths.episodes_dir, record["file"]))
+
+    def wait(
+        self,
+        unit: int,
+        *,
+        timeout: float,
+        retries: int,
+        backoff: float,
+        poll_interval: float = 0.05,
+    ) -> dict:
+        """Block until ANY worker's record for ``unit`` lands (same
+        timeout/retry/backoff contract as EpisodeStreamReader.wait)."""
+
+        def attempt():
+            deadline = time.monotonic() + max(0.1, float(timeout))
+            while time.monotonic() < deadline:
+                rec = self.poll(unit)
+                if rec is not None:
+                    return rec
+                time.sleep(poll_interval)
+            raise EpisodeStreamTimeout(
+                f"episode work unit={unit} did not land in any stream index "
+                f"within {timeout}s (root {self.paths.root})"
+            )
+
+        return call_with_retries(
+            attempt,
+            retries=max(0, int(retries)),
+            backoff=max(0.0, float(backoff)),
+            timeout=0.0,
+            description=f"episode stream wait unit={unit}",
         )

@@ -45,6 +45,9 @@ def read_jsonl_or_empty(path: str) -> list:
 
 
 ROLE_ENV = "TRLX_TPU_FLEET_ROLE"
+# Elastic fleet: this worker's stable id (int). Unset = auto-assign the
+# lowest free slot in <fleet_dir>/workers via O_EXCL registration.
+WORKER_ENV = "TRLX_TPU_FLEET_WORKER"
 ROLE_ROLLOUT = "rollout"
 ROLE_LEARNER = "learner"
 ROLE_COLOCATED = "colocated"  # internal: fleet on, no per-process role
@@ -66,6 +69,7 @@ FLEET_TRAIN_KNOBS = (
     "fleet_stream_backoff",
     "fleet_heartbeat_timeout",
     "fleet_broadcast_deadline",
+    "fleet_lease_ttl",
 )
 
 
@@ -95,9 +99,52 @@ class FleetPaths:
         return os.path.join(self.root, "heartbeats")
 
     @property
+    def leases_dir(self) -> str:
+        # Elastic work-unit lease ledger (leases.py): one O_EXCL-created
+        # generation file per (unit, claim generation).
+        return os.path.join(self.root, "leases")
+
+    @property
+    def workers_dir(self) -> str:
+        # Elastic worker registry (leases.py): worker_<k>.json membership
+        # records, O_EXCL-claimed ids, status active/left.
+        return os.path.join(self.root, "workers")
+
+    @property
     def stream_index(self) -> str:
         # Append-only episode index: {seq, file, n, weight_version, t}.
+        # Worker 0's index (and the single-worker index) — elastic peers
+        # write stream.w<k>.jsonl (stream_index_for).
         return os.path.join(self.root, "stream.jsonl")
+
+    def stream_index_for(self, worker: int) -> str:
+        """Per-worker episode index. Worker 0 keeps the single-worker name
+        ``stream.jsonl`` so the PR 16/17 layout (and every tool reading it)
+        is the elastic layout's degenerate N=1 case."""
+        if int(worker) == 0:
+            return self.stream_index
+        return os.path.join(self.root, f"stream.w{int(worker):03d}.jsonl")
+
+    def stream_indexes(self) -> dict:
+        """Every stream index present on disk, keyed by worker id — the
+        elastic learner's scan set (workers may appear mid-run, so this is
+        re-globbed per scan, not cached)."""
+        out = {}
+        if os.path.exists(self.stream_index):
+            out[0] = self.stream_index
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for name in names:
+            if name.startswith("stream.w") and name.endswith(".jsonl"):
+                try:
+                    out[int(name[len("stream.w"):-len(".jsonl")])] = os.path.join(
+                        self.root, name
+                    )
+                except ValueError:
+                    continue
+        return out
 
     @property
     def broadcast_log(self) -> str:
@@ -131,8 +178,23 @@ class FleetPaths:
             os.makedirs(d, exist_ok=True)
         return self
 
-    def episode_file(self, seq: int) -> str:
-        return os.path.join(self.episodes_dir, f"batch_{int(seq):06d}.npz")
+    def ensure_elastic(self) -> "FleetPaths":
+        """Elastic additions on top of ensure(): the lease ledger and the
+        worker registry. Kept separate so a non-elastic fleet_dir stays
+        byte-identical to the PR 16/17 layout."""
+        self.ensure()
+        for d in (self.leases_dir, self.workers_dir):
+            os.makedirs(d, exist_ok=True)
+        return self
+
+    def episode_file(self, seq: int, worker: int = 0) -> str:
+        # Worker 0 keeps the single-worker name (batch_<seq>.npz); elastic
+        # peers prefix their id so N writers never collide on a basename.
+        if int(worker) == 0:
+            return os.path.join(self.episodes_dir, f"batch_{int(seq):06d}.npz")
+        return os.path.join(
+            self.episodes_dir, f"w{int(worker):03d}_batch_{int(seq):06d}.npz"
+        )
 
     def weight_file(self, ordinal: int) -> str:
         # Keyed by ordinal, not version: a resumed learner re-publishes its
@@ -182,6 +244,13 @@ def validate_fleet_config(config) -> Optional[str]:
     env_role = os.environ.get(ROLE_ENV, "")
     set_knobs = [k for k in FLEET_TRAIN_KNOBS if getattr(t, k, None)]
     if not getattr(config.method, "fleet_disaggregate", False):
+        if getattr(config.method, "fleet_elastic", False):
+            raise ValueError(
+                "method.fleet_elastic requires method.fleet_disaggregate: "
+                "the elastic N-worker fleet generalizes the disaggregated "
+                "rollout side — there is no elastic mode without the "
+                "episode-stream/weight-broadcast transports."
+            )
         if set_knobs or env_role:
             knobs = [f"train.{k}" for k in set_knobs]
             if env_role:
@@ -230,6 +299,29 @@ def validate_fleet_config(config) -> Optional[str]:
             "training across jobs; method.max_staleness is the coupling "
             "knob for both. Disable one."
         )
+    env_worker = os.environ.get(WORKER_ENV, "")
+    if not getattr(config.method, "fleet_elastic", False):
+        if env_worker:
+            raise ValueError(
+                f"{WORKER_ENV}={env_worker!r} is set but method.fleet_elastic "
+                "is off — worker ids only exist in the elastic N-worker "
+                "fleet. Set method.fleet_elastic=true or unset the env var."
+            )
+        if getattr(t, "fleet_lease_ttl", 0):
+            raise ValueError(
+                "train.fleet_lease_ttl is set but method.fleet_elastic is "
+                "off — the lease ledger only exists in the elastic N-worker "
+                "fleet. Set method.fleet_elastic=true or clear the knob."
+            )
+    if env_worker:
+        try:
+            if int(env_worker) < 0:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"{WORKER_ENV}={env_worker!r} must be a non-negative "
+                "integer worker id (or unset for auto-assignment)."
+            ) from None
     return role
 
 
@@ -247,5 +339,9 @@ def role_timeouts(t) -> dict:
         ),
         "broadcast_deadline": float(
             t.fleet_broadcast_deadline or t.collective_deadline or 60.0
+        ),
+        # Elastic work-unit leases: unrenewed past this, a peer may reclaim.
+        "lease_ttl": float(
+            getattr(t, "fleet_lease_ttl", 0.0) or max(6.0 * heartbeat_interval, 3.0)
         ),
     }

@@ -77,6 +77,9 @@ class MetricsExporter:
         self.prefix = prefix
         self._lock = sanitize.make_lock("MetricsExporter._lock")
         self._gauges = {}
+        # (key, labels-tuple) -> float — labeled gauge series (set_gauge);
+        # rendered merged with the flat gauge of the same name.
+        self._labeled_gauges = {}
         # (key, labels-tuple) -> {"buckets": (edges...), "counts": [..],
         # "sum": float, "count": int} — cumulative, Prometheus-style.
         self._histograms = {}
@@ -177,6 +180,22 @@ class MetricsExporter:
             else:
                 self._fleet = payload
 
+    def set_gauge(self, key: str, value, labels: dict = None):
+        """Set one LABELED gauge series (``labels`` distinguishes series
+        under one metric name, e.g. ``worker="1"`` on the elastic fleet's
+        per-worker gauges). Without labels it is exactly ``update({key:
+        value})``. A labeled series renders beside the flat same-name gauge
+        under one HELP/TYPE block — Prometheus treats the unlabeled sample
+        as the fleet aggregate and each labeled one as a member."""
+        if not isinstance(value, (int, float)):
+            return
+        if not labels:
+            self.update({key: value})
+            return
+        label_key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._labeled_gauges[(key, label_key)] = float(value)
+
     def observe(self, key: str, values, buckets, labels: dict = None):
         """Fold ``values`` into the cumulative histogram ``key`` (creating
         it with ``buckets`` as its ``le`` edges on first sight). ``labels``
@@ -217,6 +236,7 @@ class MetricsExporter:
         with self._lock:
             sanitize.race_access(self, "_gauges")
             gauges = dict(self._gauges)
+            labeled = dict(self._labeled_gauges)
             histograms = {
                 k: {
                     "buckets": h["buckets"],
@@ -228,17 +248,29 @@ class MetricsExporter:
             }
             step = self._step
         # Sanitized-name collisions (a/b vs a_b) keep the last writer —
-        # exposition must never emit a duplicate metric name.
+        # exposition must never emit a duplicate metric name. A name's flat
+        # sample and its labeled series share one HELP/TYPE block (labeled
+        # samples are never duplicates: the label set disambiguates).
         by_name = {}
         for key in sorted(gauges):
             by_name[sanitize_metric_name(self.prefix + key)] = (key, gauges[key])
+        labeled_by_name = {}
+        for (key, label_key), value in sorted(labeled.items()):
+            name = sanitize_metric_name(self.prefix + key)
+            labeled_by_name.setdefault(name, (key, []))[1].append((label_key, value))
+            by_name.setdefault(name, (key, None))
         lines = []
         for name in sorted(by_name):
             key, value = by_name[name]
             kind = "counter" if key.endswith("_total") else "gauge"
             lines.append(f"# HELP {name} trlx_tpu tracker key {key!r}")
             lines.append(f"# TYPE {name} {kind}")
-            lines.append(f"{name} {_fmt_value(value)}")
+            if value is not None:
+                lines.append(f"{name} {_fmt_value(value)}")
+            for label_key, lvalue in labeled_by_name.get(name, ("", []))[1]:
+                lines.append(
+                    f"{name}{self._render_labels(label_key)} {_fmt_value(lvalue)}"
+                )
         hist_by_name = {}
         for (key, label_key), hist in sorted(histograms.items()):
             hist_by_name.setdefault(

@@ -41,6 +41,10 @@ class PPOOrchestrator(Orchestrator):
         self.chunk_size = chunk_size
         self.pipeline_loader = self.pipeline.create_loader(self.chunk_size, shuffle=True)
         self.pipeline_iterator = iter(self.pipeline_loader)
+        # Absolute position in the deterministic prompt-chunk schedule
+        # (create_loader's fixed seed makes the shuffled chunk sequence a
+        # pure function of this counter) — what seek_chunks() navigates by.
+        self._chunks_consumed = 0
         self._reward_calls = 0
 
         # Inject callbacks into the trainer (reference:
@@ -92,16 +96,53 @@ class PPOOrchestrator(Orchestrator):
             description="reward_fn",
         )
 
-    def _generate_next_chunk(self, fused=None, snapshot=None):
-        """`fused=None` follows the trainer's fused_rollout setting; False
-        forces the plain generate+recompute path (benchmark baselines).
-        `snapshot` routes generation through a boundary param snapshot
-        instead of the live (donated) TrainState — the staleness>0 producer."""
+    def _next_prompt_batch(self):
+        """Pull the next prompt chunk (epoch wrap included) and advance the
+        absolute chunk counter — the ONLY way prompts leave the loader, so
+        ``_chunks_consumed`` is always the true schedule position."""
         try:
             batch = next(self.pipeline_iterator)
         except StopIteration:
             self.pipeline_iterator = iter(self.pipeline_loader)
             batch = next(self.pipeline_iterator)
+        self._chunks_consumed += 1
+        return batch
+
+    def chunks_per_unit(self, num_rollouts: int) -> int:
+        """Prompt chunks one experience phase consumes — the elastic
+        fleet's work-unit width (unit u owns chunks [u*w, (u+1)*w))."""
+        return max(1, -(-int(num_rollouts) // max(1, int(self.chunk_size))))
+
+    def seek_chunks(self, target: int):
+        """Deterministically position the prompt stream at absolute chunk
+        ``target``. The loader's shuffle rng is seeded (pipeline.create_
+        loader default seed), so the chunk sequence is identical on every
+        worker; seeking backward rebuilds the loader (fresh rng → same
+        sequence from 0) and both directions skip forward by discarding
+        chunks. This is what lets ANY elastic worker produce work unit u's
+        exact prompt shard — the reclaim path's correctness (and the
+        N-worker staleness-0 bitwise-parity proof) rests on it. Assumes the
+        loader's constant-chunk schedule (drop_last, the fleet default)."""
+        target = int(target)
+        if target < self._chunks_consumed:
+            self.pipeline_loader = self.pipeline.create_loader(self.chunk_size, shuffle=True)
+            self.pipeline_iterator = iter(self.pipeline_loader)
+            self._chunks_consumed = 0
+        while self._chunks_consumed < target:
+            self._next_prompt_batch()
+
+    def _generate_next_chunk(self, fused=None, snapshot=None):
+        """`fused=None` follows the trainer's fused_rollout setting; False
+        forces the plain generate+recompute path (benchmark baselines).
+        `snapshot` routes generation through a boundary param snapshot
+        instead of the live (donated) TrainState — the staleness>0 producer."""
+        # The sampling key is derived from the ABSOLUTE chunk index, never
+        # from this process's rng-consumption history: chunk c's episodes are
+        # a pure function of (weights, train.seed, c), so an elastic worker
+        # reproducing a reclaimed unit — or N workers splitting the schedule
+        # — samples exactly what the serial schedule would have.
+        rng = self.rl_model.chunk_rng(self._chunks_consumed)
+        batch = self._next_prompt_batch()
         P = batch["input_ids"].shape[1]
         if fused is None:
             fused = getattr(self.rl_model, "fused_rollout", False)
@@ -111,11 +152,11 @@ class PPOOrchestrator(Orchestrator):
         # scorer needs (aux), so scoring is a ref-branch replay only.
         if fused:
             tokens, mask, stats, prefill = self.rl_model.rollout_generate_fused(
-                batch["input_ids"], batch["attention_mask"], snapshot=snapshot
+                batch["input_ids"], batch["attention_mask"], snapshot=snapshot, rng=rng
             )
             return tokens, mask, P, (stats, prefill)
         tokens, mask = self.rl_model.rollout_generate(
-            batch["input_ids"], batch["attention_mask"], snapshot=snapshot
+            batch["input_ids"], batch["attention_mask"], snapshot=snapshot, rng=rng
         )
         return tokens, mask, P, None
 
@@ -518,11 +559,7 @@ class PPOOrchestrator(Orchestrator):
         # the phase drains, so the next phase starts from a clean engine.
         submitted = 0
         while submitted < num_rollouts:
-            try:
-                batch = next(self.pipeline_iterator)
-            except StopIteration:
-                self.pipeline_iterator = iter(self.pipeline_loader)
-                batch = next(self.pipeline_iterator)
+            batch = self._next_prompt_batch()
             ids = np.asarray(batch["input_ids"])
             msk = np.asarray(batch["attention_mask"])
             take = min(int(ids.shape[0]), num_rollouts - submitted)

@@ -107,6 +107,32 @@ different ``TRLX_TPU_FAULTS`` on each role; tests/test_fleet_disagg.py):
                        collective-guard deadline, exit 117, and the incident
                        bundle names the dead host and the in-flight slot
                        states.
+
+Elastic fleet kinds (N-worker lease ledger, ``method.fleet_elastic``;
+fired per WORKER process — tests/test_fleet_elastic.py). Work-unit races
+make exact-tick matching flaky (a peer may win unit N's lease), so these
+three key on the unit THRESHOLD instead: each fires once, on the first
+opportunity at or past its ``@N``:
+
+- ``worker_kill_mid_lease@N``  — this worker dies abruptly
+                       (``os._exit(1)``) right after CLAIMING its first
+                       work unit >= N, lease held, nothing streamed → the
+                       lease expires unrenewed, a peer reclaims the unit at
+                       the next generation and produces it, and the learner
+                       sees no gap in work units (exactly-once intact);
+- ``slow_worker_reclaim@N``    — this worker sleeps
+                       ``TRLX_TPU_SLOW_WORKER_SECONDS`` (default 3x the
+                       lease TTL) right after claiming its first unit >= N,
+                       then wakes and produces it ANYWAY → a peer reclaimed
+                       and produced the same unit meanwhile, so two records
+                       land for one unit and the learner's
+                       (work_unit, episode_key) dedup consumes exactly one
+                       (``fleet/episodes_deduped_total`` fires);
+- ``worker_join_mid_run@N``    — this worker DEFERS registration until the
+                       learner's consume cursor reaches N → a mid-run
+                       join: it registers, adopts the latest broadcast
+                       weights, and starts claiming leases against peers
+                       that have been producing since unit 0.
 """
 
 import os
@@ -137,6 +163,9 @@ KINDS = (
     "weight_push_torn",
     "version_switch_storm",
     "mid_decode_host_kill",
+    "worker_kill_mid_lease",
+    "slow_worker_reclaim",
+    "worker_join_mid_run",
 )
 
 _ENTRY_RE = re.compile(r"^([a-z_]+)@(\d+)$")
@@ -184,6 +213,26 @@ class FaultPlan:
                 f.fired = True
                 return True
         return False
+
+    def fire_at_or_after(self, kind: str, tick) -> bool:
+        """Threshold variant of fire(): True exactly once per entry, on the
+        first call whose tick is >= the entry's ``@N``. The elastic-fleet
+        worker kinds use this — which WORKER wins unit N's lease is a race,
+        so an exact-tick match could silently never fire."""
+        for f in self.faults:
+            if not f.fired and f.kind == kind and int(tick) >= f.at:
+                f.fired = True
+                return True
+        return False
+
+    def pending_at(self, kind: str):
+        """The ``@N`` of the first unfired entry of ``kind``, or None —
+        lets an injection site poll an external condition (e.g. the
+        learner's cursor) before declaring the tick reached."""
+        for f in self.faults:
+            if not f.fired and f.kind == kind:
+                return f.at
+        return None
 
     def __bool__(self) -> bool:
         return bool(self.faults)

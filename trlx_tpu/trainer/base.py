@@ -696,6 +696,14 @@ class JaxBaseTrainer(BaseRLTrainer):
             self.rng, sub = jax.random.split(self.rng)
             return sub
 
+    def chunk_rng(self, chunk: int):
+        """Sampling key for absolute prompt chunk ``chunk`` — a pure function
+        of (train.seed, chunk), independent of this process's ``next_rng``
+        consumption history. Rollout generation keys off the schedule
+        position, not the call count, so every elastic worker (or a resumed
+        learner) sampling chunk c draws exactly the serial run's tokens."""
+        return jax.random.fold_in(jax.random.PRNGKey(self.config.train.seed), int(chunk))
+
     def put_batch(self, tree):
         """Host batch → device, batch dim sharded over (dp, fsdp).
 

@@ -591,25 +591,29 @@ class PPOTrainer(JaxBaseTrainer):
             self._score_rm_fns[P] = fn
         return fn
 
-    def rollout_generate(self, input_ids, attention_mask, snapshot=None):
+    def rollout_generate(self, input_ids, attention_mask, snapshot=None, rng=None):
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
+        if rng is None:
+            rng = self.next_rng()
         # _dispatch_lock: generation runs on the producer thread at
         # max_staleness > 0 while the main thread dispatches train steps —
         # see JaxBaseTrainer.__init__ for the rendezvous hazard.
         with self._dispatch_lock:
             return self._generate_fn(
-                self._decode_variables(snapshot), batch["i"], batch["m"], self.next_rng()
+                self._decode_variables(snapshot), batch["i"], batch["m"], rng
             )
 
-    def rollout_generate_fused(self, input_ids, attention_mask, snapshot=None):
+    def rollout_generate_fused(self, input_ids, attention_mask, snapshot=None, rng=None):
         """Generation that also emits the rollout statistics (sampled-token
         logprobs, values, branch hiddens) collected inside the decode loop.
         Returns (tokens, mask, stats, prefill_extras) — feed the last two to
         rollout_score_fused."""
         batch = self.put_batch({"i": input_ids, "m": attention_mask})
+        if rng is None:
+            rng = self.next_rng()
         with self._dispatch_lock:
             return self._generate_fused_fn(
-                self._decode_variables(snapshot), batch["i"], batch["m"], self.next_rng()
+                self._decode_variables(snapshot), batch["i"], batch["m"], rng
             )
 
     def _rollout_score_fused_impl(self, extras, tokens, mask, scores, kl_coef, logprob, value, bh_steps, bh_prefill, *, prompt_length: int):
