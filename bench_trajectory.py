@@ -207,6 +207,13 @@ def _parse_smoke(path: str):
             out["static_decode_tokens_per_s"] = float(engine["static_decode_tokens_per_s"])
         if isinstance(engine.get("slot_occupancy"), (int, float)):
             out["engine_slot_occupancy"] = float(engine["slot_occupancy"])
+    spec = smoke.get("spec_decode", {})
+    if isinstance(spec.get("decode_tokens_per_s"), (int, float)):
+        out["spec_decode_tokens_per_s"] = float(spec["decode_tokens_per_s"])
+        if isinstance(spec.get("accept_rate"), (int, float)):
+            out["spec_accept_rate"] = float(spec["accept_rate"])
+        if isinstance(spec.get("speedup_vs_nonspec"), (int, float)):
+            out["spec_speedup_vs_nonspec"] = float(spec["speedup_vs_nonspec"])
     fleet = smoke.get("fleet_elastic", {})
     if isinstance(fleet.get("episodes_per_s_2workers"), (int, float)):
         out["fleet_episodes_per_s_2workers"] = float(fleet["episodes_per_s_2workers"])

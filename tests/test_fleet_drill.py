@@ -40,7 +40,7 @@ _DRILL_WORKER = r"""
 import json, os, sys, threading, time
 import urllib.request
 
-mode = sys.argv[1]            # "slow" | "hang" | "engine" | "engine_kill"
+mode = sys.argv[1]  # "slow" | "hang" | "engine" | "engine_spec" | "engine_kill"
 pid = int(sys.argv[2])
 port = sys.argv[3]
 ckpt = sys.argv[4]
@@ -98,7 +98,7 @@ def make_config(total_steps):
         config.train.log_interval = 10**6
     return config
 
-if mode in ("engine", "engine_kill"):
+if mode in ("engine", "engine_spec", "engine_kill"):
     # Multi-process ENGINE contract (engine/rollout_engine.py): every host
     # submits the SAME global prompt set — identical slot schedules by
     # construction, verified per phase by the slot-schedule crc.
@@ -161,12 +161,16 @@ elif mode == "hang":
     )
     print(f"fleet hang proc {pid} FINISHED WITHOUT ABORT")
 
-elif mode in ("engine", "engine_kill"):
+elif mode in ("engine", "engine_spec", "engine_kill"):
     # 2-process continuous-batching engine run: replicated slot state
     # (_globalize), identical schedules cross-checked per phase by
     # verify_engine_schedule under the engine/schedule_verify guard.
     # - clean leg: completes → proves the per-phase crc check passes when
     #   schedules really match;
+    # - engine_spec: same clean leg with SPECULATION armed — each verify
+    #   dispatch folds its accepted-token total into the schedule crc, so
+    #   the per-phase check also proves the two hosts accepted identical
+    #   draft prefixes on every dispatch;
     # - TRLX_TPU_ENGINE_SCHEDULE_SKEW on proc 1: the phase-end check raises
     #   HostDesync NAMING host 1 on every host — desync by name, not hang;
     # - engine_kill: proc 1 carries mid_decode_host_kill@2 and dies abruptly
@@ -174,15 +178,18 @@ elif mode in ("engine", "engine_kill"):
     #   at its next guarded cross-host sync and aborts exit-117 with an
     #   incident bundle carrying its slot states — this FINISHED print is
     #   only reachable on proc 0 if detection FAILED.
-    config = make_config(3 if mode == "engine" else 10)
+    config = make_config(10 if mode == "engine_kill" else 3)
     config.method.rollout_engine = True
     config.method.engine_steps_per_sync = 2
+    if mode == "engine_spec":
+        config.method.spec_decode = "ngram"
+        config.method.spec_k = 3
     trlx_tpu.train(
         reward_fn=reward_fn, prompts=prompts, eval_prompts=eval_prompts,
         metric_fn=metric_fn, config=config, logit_mask=logit_mask,
     )
-    print(f"fleet {mode} proc {pid} DONE" if mode == "engine"
-          else f"fleet {mode} proc {pid} FINISHED WITHOUT ABORT")
+    print(f"fleet {mode} proc {pid} FINISHED WITHOUT ABORT"
+          if mode == "engine_kill" else f"fleet {mode} proc {pid} DONE")
 """
 
 
@@ -423,6 +430,28 @@ def test_fleet_drill_engine_two_process_clean(tmp_path):
         if os.path.exists(os.path.join(incidents, d, "fleet_incident.json"))
     ]
     assert not bundles, f"clean engine drill left incident bundles: {bundles}"
+
+
+def test_fleet_drill_engine_spec_two_process_clean(tmp_path):
+    """Drill C (speculative leg, ISSUE 19): the engine runs at
+    process_count()==2 WITH spec_decode armed. The host-side drafter makes
+    identical proposals on every host (same prompt set, same accepted
+    stream), every verify dispatch folds its accepted-token total into the
+    slot-schedule crc, and the per-phase crc check stays clean — speculation
+    does not desync the slot managers."""
+    procs, ckpt = _launch(tmp_path, "engine_spec", {})
+    outs = _communicate(procs)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        _skip_if_distributed_unavailable(p, out)
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+        assert f"fleet engine_spec proc {pid} DONE" in out
+    incidents = os.path.join(ckpt, "incidents")
+    bundles = [
+        d
+        for d in (os.listdir(incidents) if os.path.isdir(incidents) else [])
+        if os.path.exists(os.path.join(incidents, d, "fleet_incident.json"))
+    ]
+    assert not bundles, f"clean engine_spec drill left incident bundles: {bundles}"
 
 
 def test_fleet_drill_engine_schedule_skew_is_named_desync(tmp_path):

@@ -694,3 +694,33 @@ def test_bench_trajectory_surfaces_manifest_reason_for_no_data_run(
     )
     assert traj["runs"][0]["reason"] == "bench run exited rc=124"
     assert "manifest" not in traj["runs"][0]
+
+
+def test_bench_trajectory_parses_spec_decode_smoke_section(tmp_path):
+    """The smoke fold must surface the spec-decode probe's paired numbers
+    (rate + accept rate + speedup) and stay silent when the section is
+    absent (pre-PR-19 artifacts)."""
+    import bench_trajectory
+
+    path = str(tmp_path / "BENCH_SMOKE.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "spec_decode": {
+                    "decode_tokens_per_s": 39793.1,
+                    "accept_rate": 1.0,
+                    "speedup_vs_nonspec": 2.7,
+                }
+            },
+            f,
+        )
+    out = bench_trajectory._parse_smoke(path)
+    assert out["spec_decode_tokens_per_s"] == 39793.1
+    assert out["spec_accept_rate"] == 1.0
+    assert out["spec_speedup_vs_nonspec"] == 2.7
+
+    with open(path, "w") as f:
+        json.dump({"rollout": {"tokens_per_s": 5.0}}, f)
+    out = bench_trajectory._parse_smoke(path)
+    assert "spec_decode_tokens_per_s" not in out
+    assert out["rollout_tokens_per_s"] == 5.0

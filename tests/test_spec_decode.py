@@ -1,0 +1,473 @@
+"""Per-slot speculative decoding in the rollout engine (ISSUE 19).
+
+Parity tier (the acceptance criterion): with greedy sampling the speculative
+engine is token-for-token identical to the non-speculative engine — int8 KV
+on and off, soft prompts on and off — with exactly ONE compiled verify
+program. Accounting tier: dispatches vs accepted tokens split, accept-rate
+gauges, a perfect drafter reaching accept rate 1.0 with ceil(R/K) dispatches.
+Interaction tier: a draft window straddling an in-flight weight switch
+carries correct version_spans over ACCEPTED tokens only; rejection sampling
+against a point-mass (forced-bigram) target is exact. E2E tier: a PPO run
+with the engine + speculation + an on-device RM trains and tears down, and
+the soft-prompt trainer runs through the engine — the two guards this PR
+lifted."""
+
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"))
+
+import trlx_tpu  # noqa: E402
+from randomwalks import base_config, generate_random_walks  # noqa: E402
+from trlx_tpu.engine import NgramDrafter, RolloutEngine, make_drafter  # noqa: E402
+from trlx_tpu.models import LMConfig, LMWithValueHead  # noqa: E402
+from trlx_tpu.ops.generate import make_generate_fn  # noqa: E402
+from trlx_tpu.ops.sampling import GenerateConfig  # noqa: E402
+
+
+def _tiny_model(**overrides):
+    cfg = LMConfig(
+        vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=64,
+        dtype="float32", **overrides,
+    )
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (3, 6), 2, cfg.vocab_size)
+    ids = ids.at[0, :2].set(0)
+    mask = jnp.ones((3, 6), jnp.int32).at[0, :2].set(0)
+    params = {"params": model.init(rng, ids, mask)["params"]}
+    return model, params, np.asarray(ids), np.asarray(mask)
+
+
+def _drain(engine):
+    episodes = []
+    for _ in range(300):
+        episodes.extend(engine.step())
+        if engine.idle:
+            break
+    return episodes
+
+
+def _by_prompt(episodes):
+    return {tuple(e.prompt_ids.tolist()): e for e in episodes}
+
+
+def _run_engine(model, params, groups, gcfg, **kw):
+    engine = RolloutEngine(
+        model, gcfg, n_slots=kw.pop("n_slots", 2), prompt_width=6,
+        prefill_batch=2, steps_per_sync=3, rng=jax.random.PRNGKey(2), **kw,
+    )
+    engine.update_weights(params, version=1)
+    for ids, msk in groups:
+        engine.submit(ids, msk)
+    episodes = _drain(engine)
+    stats = engine.stats(reset=False)
+    return engine, episodes, stats
+
+
+class OracleDrafter:
+    """Perfect drafter for tests: replays a known-good continuation per
+    prompt, so every window position matches the model and the engine's
+    accept rate must hit exactly 1.0. Implements the drafter protocol
+    (reset_slot/observe/propose) and tracks each slot's frontier position
+    from the observed accepted tokens only. Keyed by the UNPADDED prompt —
+    what reset_slot receives."""
+
+    def __init__(self, ref, pad=0):
+        self.ref = {k: [int(t) for t in v] for k, v in ref.items()}
+        self.pad = int(pad)
+        self.pos = {}
+        self.rows = {}
+
+    def reset_slot(self, slot, prompt_tokens):
+        self.rows[slot] = self.ref[tuple(int(t) for t in prompt_tokens)]
+        self.pos[slot] = 0
+
+    def observe(self, slot, tokens):
+        self.pos[slot] = self.pos.get(slot, 0) + max(0, len(tokens) - 1)
+
+    def propose(self, slot, last_token, k):
+        row = self.rows.get(slot, [])
+        p = self.pos.get(slot, 0)
+        out = row[p : p + k]
+        return out + [self.pad] * (k - len(out))
+
+
+# -------------------------------------------------------------- greedy parity
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_spec_greedy_parity_token_for_token(quant):
+    """THE acceptance test: spec_decode="ngram" greedy decode equals the
+    non-spec engine token for token and mask bit for mask bit — mixed
+    response lengths via a discovered eos, slot refill mid-run, ONE compiled
+    verify program."""
+    model, params, ids, msk = _tiny_model(kv_cache_quant=quant)
+    free = GenerateConfig(max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0)
+    toks, _ = make_generate_fn(model, free)(
+        params, jnp.asarray(ids), jnp.asarray(msk), jax.random.PRNGKey(1)
+    )
+    # an eos the greedy decode emits at different depths → mixed lengths
+    first_at = {}
+    for row in np.asarray(toks)[:, ids.shape[1] :]:
+        seen = {}
+        for step, t in enumerate(row.tolist()):
+            seen.setdefault(int(t), step)
+        for t, step in seen.items():
+            first_at.setdefault(t, set()).add(step)
+    eos = max(first_at, key=lambda t: len(first_at[t]))
+    gcfg = GenerateConfig(max_new_tokens=8, do_sample=False, eos_token_id=eos, pad_token_id=0)
+
+    e0, ref_eps, _ = _run_engine(model, params, [(ids, msk)], gcfg)
+    e0.shutdown()
+    ref = _by_prompt(ref_eps)
+
+    e1, eps, stats = _run_engine(
+        model, params, [(ids, msk)], gcfg, spec_decode="ngram", spec_k=4
+    )
+    assert len(eps) == 3
+    assert e1.num_verify_traces == 1, "verify retraced: slot state leaked into shapes"
+    assert e1.num_decode_traces == 0  # spec engines never touch the 1-token path
+    for ep in eps:
+        r = ref[tuple(ep.prompt_ids.tolist())]
+        np.testing.assert_array_equal(ep.response_ids, r.response_ids)
+        np.testing.assert_array_equal(ep.response_mask, r.response_mask)
+        assert ep.decode_steps == r.decode_steps
+
+    # the dispatch/token split: tokens are ACCEPTED tokens, dispatches paid
+    # K window positions each, and the accept-rate gauge ties them together
+    total = sum(ep.decode_steps for ep in eps)
+    assert stats["engine/decode_tokens"] == stats["engine/gen_tokens"] == total
+    assert stats["engine/decode_dispatches"] < total  # speculation paid off
+    assert 0.0 < stats["engine/spec_accept_rate"] <= 1.0
+    assert stats["engine/spec_accepted"] == total
+    e1.shutdown()
+
+
+def test_spec_off_path_is_cold_and_config_defaults_off():
+    """spec_decode off must leave NO speculative machinery armed: no drafter,
+    no verify program, no spec_resid state key, no spec stats keys, no cache
+    scratch tail — and the method-config defaults keep it off (GL005: the
+    default must be falsy, not "off")."""
+    from trlx_tpu.data.method_configs import PPOConfig
+
+    assert PPOConfig.spec_decode == "" and PPOConfig.spec_k == 0
+
+    model, params, ids, msk = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=4, do_sample=False, eos_token_id=None, pad_token_id=0)
+    engine = RolloutEngine(model, gcfg, n_slots=2, prompt_width=6, prefill_batch=2)
+    assert engine._verify is None and engine.drafter is None
+    assert engine.cache_len == 6 + 4  # no spec_k-1 scratch tail
+    engine.update_weights(params)
+    engine.submit(ids, msk)
+    _drain(engine)
+    assert "spec_resid" not in engine._state
+    stats = engine.stats(reset=False)
+    assert "engine/spec_accept_rate" not in stats
+    # the split gauges exist on BOTH paths; off-path they reconcile as
+    # dispatches * steps_per_sync >= tokens (whole-pool steps paid)
+    assert stats["engine/decode_tokens"] == stats["engine/gen_tokens"]
+    assert stats["engine/decode_dispatches"] == stats["engine/decode_calls"]
+    engine.shutdown()
+
+    # "off" normalizes to the cold path too; junk raises; k<2 raises
+    e2 = RolloutEngine(model, gcfg, n_slots=2, prompt_width=6, spec_decode="off")
+    assert e2._verify is None
+    e2.shutdown()
+    with pytest.raises(ValueError, match="spec_decode"):
+        RolloutEngine(model, gcfg, n_slots=2, prompt_width=6, spec_decode="beam")
+    with pytest.raises(ValueError, match="spec_k"):
+        RolloutEngine(model, gcfg, n_slots=2, prompt_width=6, spec_decode="ngram", spec_k=1)
+    with pytest.raises(NotImplementedError):
+        make_drafter("model", 0)
+
+
+def test_oracle_drafter_reaches_accept_rate_one():
+    """Perfect-draft degenerate case: a drafter that replays the model's own
+    greedy continuation must be accepted in full — accept rate exactly 1.0
+    and ceil(R/K) dispatches per episode wave, the upper bound the bench
+    probe's >= 2x assertion rides on."""
+    model, params, ids, msk = _tiny_model()
+    R, K = 8, 4
+    gcfg = GenerateConfig(max_new_tokens=R, do_sample=False, eos_token_id=None, pad_token_id=0)
+    e0, ref_eps, _ = _run_engine(model, params, [(ids, msk)], gcfg, n_slots=3)
+    e0.shutdown()
+    ref = {
+        tuple(e.prompt_ids[e.prompt_mask > 0].tolist()): e.response_ids[: e.decode_steps]
+        for e in ref_eps
+    }
+    oracle = OracleDrafter(ref, pad=0)
+    e1, eps, stats = _run_engine(
+        model, params, [(ids, msk)], gcfg,
+        n_slots=3, spec_decode="ngram", spec_k=K, drafter=oracle,
+    )
+    assert len(eps) == 3
+    assert stats["engine/spec_accept_rate"] == 1.0
+    assert stats["engine/decode_tokens"] == 3 * R
+    # all 3 slots ride the same waves: R/K dispatches total
+    assert stats["engine/decode_dispatches"] == R // K
+    for ep in eps:
+        np.testing.assert_array_equal(
+            ep.response_ids[:R], ref[tuple(ep.prompt_ids[ep.prompt_mask > 0].tolist())]
+        )
+    e1.shutdown()
+
+
+# ----------------------------------------------- speculation x in-flight push
+
+
+def test_spec_version_spans_straddle_inflight_switch():
+    """A draft window straddling an in-flight weight switch: the push lands
+    at the sync boundary between two verify dispatches, and the harvested
+    episodes split their version_spans at the ACCEPTED-token position — the
+    span arithmetic counts accepted tokens, never window positions paid."""
+    model, params, ids, msk = _tiny_model()
+    R, K = 6, 3
+    gcfg = GenerateConfig(max_new_tokens=R, do_sample=False, eos_token_id=None, pad_token_id=0)
+    e0, ref_eps, _ = _run_engine(model, params, [(ids, msk)], gcfg, n_slots=3)
+    e0.shutdown()
+    ref = {
+        tuple(e.prompt_ids[e.prompt_mask > 0].tolist()): e.response_ids[: e.decode_steps]
+        for e in ref_eps
+    }
+    oracle = OracleDrafter(ref, pad=0)
+    engine = RolloutEngine(
+        model, gcfg, n_slots=3, prompt_width=6, prefill_batch=3,
+        steps_per_sync=3, rng=jax.random.PRNGKey(2),
+        spec_decode="ngram", spec_k=K, drafter=oracle,
+    )
+    engine.update_weights(params, version=1)
+    engine.submit(ids, msk)
+    eps = engine.step()
+    assert eps == []  # one verify dispatch: K of R tokens accepted
+    assert [s["n_gen"] for s in engine.slot_states()] == [K, K, K]
+    # slots are mid-decode RIGHT NOW — push without draining
+    engine.update_weights(params, version=2)
+    eps = _drain(engine)
+    assert len(eps) == 3
+    for ep in eps:
+        assert ep.version_spans == [(1, K), (2, R - K)]
+        assert ep.weight_version == 2
+        # same params under a new version: the decode stream is unchanged
+        np.testing.assert_array_equal(
+            ep.response_ids[:R], ref[tuple(ep.prompt_ids[ep.prompt_mask > 0].tolist())]
+        )
+    # accepted-token accounting survived the switch
+    stats = engine.stats(reset=False)
+    assert stats["engine/decode_tokens"] == 3 * R
+    assert stats["engine/weight_switches"] == 1
+    engine.shutdown()
+
+
+def test_spec_schedule_fingerprint_deterministic():
+    """Speculation folds each dispatch's accepted-token total into the slot
+    schedule crc — two identical runs must fingerprint identically (the
+    2-process drill in test_fleet_drill.py checks the same crc across
+    hosts)."""
+    model, params, ids, msk = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=6, do_sample=False, eos_token_id=None, pad_token_id=0)
+
+    def fingerprint():
+        e, _, _ = _run_engine(
+            model, params, [(ids, msk)], gcfg, spec_decode="ngram", spec_k=3
+        )
+        fp = e.schedule_fingerprint()
+        e.shutdown()
+        return fp
+
+    fp1, fp2 = fingerprint(), fingerprint()
+    assert fp1 == fp2 != 0
+
+
+# -------------------------------------------------------- rejection sampling
+
+
+def test_spec_sampled_point_mass_bigram_is_exact():
+    """Rejection sampling against a deterministic target: a forced-bigram
+    logit processor makes the sampled distribution a point mass, so the
+    matching bigram drafter must be accepted with probability exactly 1
+    (p_draft == 1.0 in fp32 — the bench probe's perfect-draft case) and the
+    spec stream must equal the non-spec sampled stream token for token."""
+    model, params, ids, msk = _tiny_model()
+    V, eos = 23, 22
+    allow = jnp.asarray(
+        np.stack([np.eye(V, dtype=np.float32)[(t + 1) % V] for t in range(V)])
+    )
+
+    def forced_bigram(logits, ctx):
+        gate = allow[ctx["last_token"]]
+        return jnp.where(gate > 0, 0.0, -1e9)
+
+    gcfg = GenerateConfig(max_new_tokens=8, do_sample=True, temperature=1.0,
+                          eos_token_id=eos, pad_token_id=0)
+    # prompts ending at eos-5 .. eos-3 → response lengths 5, 4, 3
+    ids = np.array(ids)
+    for b in range(3):
+        ids[b, -1] = eos - 5 + b
+    e0, ref_eps, _ = _run_engine(model, params, [(ids, msk)], gcfg, processor=forced_bigram)
+    e0.shutdown()
+    ref = _by_prompt(ref_eps)
+    for key, ep in ref.items():
+        assert ep.decode_steps == eos - key[-1]  # the forced chain ran to eos
+
+    drafter = NgramDrafter(0, transition=lambda t: (t + 1) % V)
+    e1, eps, stats = _run_engine(
+        model, params, [(ids, msk)], gcfg,
+        processor=forced_bigram, spec_decode="ngram", spec_k=4, drafter=drafter,
+    )
+    for ep in eps:
+        r = ref[tuple(ep.prompt_ids.tolist())]
+        np.testing.assert_array_equal(ep.response_ids, r.response_ids)
+        np.testing.assert_array_equal(ep.response_mask, r.response_mask)
+    # point-mass target: nothing inside the chain is ever rejected — only
+    # eos/budget clipping keeps the rate below 1
+    assert stats["engine/spec_accept_rate"] > 0.5
+    assert stats["engine/decode_dispatches"] < stats["engine/decode_tokens"]
+    e1.shutdown()
+
+
+def test_spec_sampled_free_distribution_runs_clean():
+    """Unconstrained sampled speculation (the realistic low-accept regime):
+    episodes complete with well-formed masks, every gauge stays in range,
+    and the forced position 0 keeps progress >= 1 token per dispatch."""
+    model, params, ids, msk = _tiny_model()
+    gcfg = GenerateConfig(max_new_tokens=8, do_sample=True, temperature=1.0,
+                          eos_token_id=None, pad_token_id=0)
+    engine, eps, stats = _run_engine(
+        model, params, [(ids, msk)], gcfg, spec_decode="ngram", spec_k=4
+    )
+    assert len(eps) == 3
+    for ep in eps:
+        assert ep.decode_steps == 8
+        assert ep.response_mask.sum() == 8
+    assert 0.0 < stats["engine/spec_accept_rate"] <= 1.0
+    # forced position 0: dispatches can never exceed tokens generated
+    assert stats["engine/decode_dispatches"] <= stats["engine/decode_tokens"]
+    assert engine.num_verify_traces == 1
+    engine.shutdown()
+
+
+# ------------------------------------------------------ lifted engine guards
+
+
+def test_soft_prompt_engine_parity_with_and_without_spec():
+    """Lifted guard 1: a soft-prompt model decodes through the engine — the
+    per-slot prefill replays the learned prefix into cache rows [0, n_soft)
+    — and both the plain and speculative engines match whole-batch
+    generate() token for token."""
+    model, params, ids, msk = _tiny_model(n_soft_tokens=3)
+    gcfg = GenerateConfig(max_new_tokens=8, do_sample=False, eos_token_id=None, pad_token_id=0)
+    toks, m = make_generate_fn(model, gcfg)(
+        params, jnp.asarray(ids), jnp.asarray(msk), jax.random.PRNGKey(1)
+    )
+    toks, m = np.asarray(toks), np.asarray(m)
+    P = ids.shape[1]
+    ref = {tuple(ids[b].tolist()): (toks[b, P:], m[b, P:]) for b in range(3)}
+
+    for kw in ({}, dict(spec_decode="ngram", spec_k=4)):
+        engine, eps, _ = _run_engine(model, params, [(ids, msk)], gcfg, **kw)
+        assert len(eps) == 3
+        for ep in eps:
+            rt, rm = ref[tuple(ep.prompt_ids.tolist())]
+            np.testing.assert_array_equal(ep.response_ids, rt)
+            np.testing.assert_array_equal(ep.response_mask, rm)
+        engine.shutdown()
+
+
+# ------------------------------------------------------------ e2e acceptance
+
+
+@pytest.fixture(scope="module")
+def task():
+    return generate_random_walks(n_nodes=15, max_length=8, n_walks=60, seed=1000)
+
+
+def _train(task, ckpt_dir, config):
+    _, logit_mask, metric_fn, reward_fn = task
+    config.train.checkpoint_dir = str(ckpt_dir)
+    prompts = [[int(np.random.default_rng(i).integers(1, 15))] for i in range(32)]
+    model = trlx_tpu.train(
+        reward_fn=None if config.model.has_reward_model else reward_fn,
+        prompts=prompts,
+        eval_prompts=[[1]],
+        metric_fn=metric_fn,
+        config=config,
+        logit_mask=logit_mask,
+    )
+    with open(os.path.join(str(ckpt_dir), "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    return model, records
+
+
+def _lean(config, total_steps=3):
+    config.train.total_steps = total_steps
+    config.train.epochs = 2
+    config.train.batch_size = 16
+    config.train.eval_interval = 100
+    config.method.num_rollouts = 16
+    config.method.chunk_size = 16
+    config.method.rollout_engine = True
+    config.method.engine_slots = 8
+    config.method.prefill_batch = 4
+    config.method.engine_steps_per_sync = 4
+    return config
+
+
+def test_ppo_engine_spec_with_on_device_rm_trains(task, tmp_path):
+    """Lifted guard 2 + the full speculative stack: PPO through the engine
+    with spec_decode armed AND rollout scoring by an on-device reward model
+    (no host reward_fn) — trains, exports the dispatch/token split and
+    accept-rate gauges, and tears down without leaking threads."""
+    config = _lean(base_config("ppo", 15, 8))
+    config.model.reward_model_arch = dict(config.model.model_arch)
+    config.method.spec_decode = "ngram"
+    config.method.spec_k = 3
+    model, records = _train(task, tmp_path / "rm_spec", config)
+    losses = [r["loss"] for r in records if "loss" in r]
+    assert len(losses) == 3 and all(np.isfinite(losses))
+    assert model.has_reward_model and model.reward_fn is None
+    # the dispatch/token split flowed to the tracker, and speculation paid
+    # accepted tokens into the same ledger the non-spec engine fills
+    split = [r for r in records if "exp_decode_dispatches" in r]
+    assert split, "exp_decode_dispatches never exported"
+    for r in split:
+        assert r["exp_decode_dispatches"] <= r["exp_decode_tokens"]
+    rates = [r["engine/spec_accept_rate"] for r in records if "engine/spec_accept_rate" in r]
+    assert rates and all(0.0 < x <= 1.0 for x in rates)
+    occ = [r["engine/slot_occupancy"] for r in records if "engine/slot_occupancy" in r]
+    assert occ and all(0.0 < o <= 1.0 for o in occ)
+    assert model._rollout_engine is None
+    assert not any(t.name.startswith("trlx-") for t in threading.enumerate())
+
+
+def test_ppo_softprompt_trains_through_engine(task, tmp_path):
+    """Lifted guard 1 end to end: the soft-prompt trainer (frozen trunk,
+    learned prefix) routes experience through the rollout engine — the
+    per-slot prefill replays the prefix — and the run completes cleanly."""
+    import dataclasses
+
+    from trlx_tpu.data.method_configs import PPOSoftpromptConfig
+
+    config = _lean(base_config("ppo", 15, 8), total_steps=2)
+    config.model.model_type = "ppo_softprompt"
+    config.method = PPOSoftpromptConfig(
+        **{
+            **dataclasses.asdict(config.method),
+            "name": "pposoftpromptconfig",
+            "n_soft_tokens": 4,
+        }
+    )
+    model, records = _train(task, tmp_path / "soft_eng", config)
+    losses = [r["loss"] for r in records if "loss" in r]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+    assert model.model.cfg.n_soft_tokens == 4
+    occ = [r["engine/slot_occupancy"] for r in records if "engine/slot_occupancy" in r]
+    assert occ and all(0.0 < o <= 1.0 for o in occ)
+    assert model._rollout_engine is None

@@ -672,6 +672,7 @@ TILING_HOME = "ops/tiling.py"
 TILING_FACTORIES = {
     "decode_block_layout",
     "slot_decode_layout",
+    "spec_verify_layout",
     "flash_block_layout",
     "fused_logprob_block_layout",
     "check_layout",

@@ -134,6 +134,20 @@ class PPOConfig(MethodConfig):
     # slots sit idle for at most this many steps before harvest+refill (the
     # occupancy cost of the amortization).
     engine_steps_per_sync: int = 8
+    # spec_decode: per-slot speculative decoding inside the rollout engine.
+    # "" / "off" (default) keeps the one-token-per-dispatch decode program
+    # byte-identical; "ngram" arms the host-side per-slot bigram drafter
+    # (engine/drafters.py) — each sync proposes spec_k tokens per slot and
+    # ONE jitted batched verify program scores every slot's draft window at
+    # once, accepting the longest matching prefix (greedy) or via standard
+    # rejection sampling (do_sample). Requires rollout_engine. "model"
+    # (drafter-model hook) is reserved and raises NotImplementedError.
+    spec_decode: str = ""
+    # spec_k: draft window width per verify dispatch (position 0 is the
+    # model's own next token, so k-1 drafted tokens ride along and every
+    # live slot advances >= 1 token per dispatch). 0 = auto (4 when
+    # spec_decode is armed). Values >= 2 required when armed.
+    spec_k: int = 0
     # Disaggregated rollout/learner fleet (trlx_tpu/fleet): dedicated
     # rollout and learner JOBS (each its own single-controller JAX world)
     # coupled by a versioned weight broadcast and a bounded-staleness

@@ -4,6 +4,7 @@ See rollout_engine.RolloutEngine — the `submit(prompts) -> stream of finished
 episodes` boundary ppo_orchestrator.make_experience and the RolloutProducer
 consume when ``method.rollout_engine`` is on."""
 
+from trlx_tpu.engine.drafters import NgramDrafter, make_drafter
 from trlx_tpu.engine.rollout_engine import Episode, RolloutEngine
 
-__all__ = ["Episode", "RolloutEngine"]
+__all__ = ["Episode", "RolloutEngine", "NgramDrafter", "make_drafter"]

@@ -119,17 +119,14 @@ class RolloutEngine:
         processor: Optional[Callable] = None,
         prefill_batch: int = 4,
         steps_per_sync: int = 8,
+        spec_decode: str = "",
+        spec_k: int = 0,
+        drafter=None,
         dispatch_lock=None,
         monitor=None,
         rng=None,
         collective_deadline=None,
     ):
-        if model.cfg.n_soft_tokens > 0:
-            raise ValueError(
-                "the continuous-batching engine does not support soft prompts "
-                "yet (per-slot prefill would need to replay the soft prefix "
-                "per admission); use the chunked rollout path"
-            )
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.model = model
@@ -137,7 +134,32 @@ class RolloutEngine:
         self.processor = processor
         self.n_slots = int(n_slots)
         self.prompt_width = int(prompt_width)
-        self.cache_len = self.prompt_width + int(gen_cfg.max_new_tokens)
+        # Soft-prompt prefix: admission prefills replay the learned prefix
+        # through the model (prepend_soft default) into each slot's cache
+        # rows [0, n_soft); decode/verify then run with prepend_soft=False
+        # against the absolute write offset — the ops/generate.py split,
+        # per slot.
+        self.n_soft = int(model.cfg.n_soft_tokens)
+        spec = (spec_decode or "").lower()
+        if spec == "off":
+            spec = ""
+        if spec not in ("", "ngram", "model"):
+            raise ValueError(f"unknown spec_decode mode: {spec_decode!r}")
+        self.spec_decode = spec
+        self.spec_k = int(spec_k) if spec_k else (4 if spec else 0)
+        if spec and self.spec_k < 2:
+            raise ValueError(
+                f"spec_k must be >= 2 when spec_decode is armed, got {self.spec_k}"
+            )
+        self.cache_len = self.n_soft + self.prompt_width + int(gen_cfg.max_new_tokens)
+        if spec:
+            # Scratch tail: the verify window scatters spec_k tokens at the
+            # live frontier; the last budgeted token can sit at position
+            # cache_len-1, so spec_k-1 scratch columns keep the per-row
+            # dynamic_update_slice from clamping a live row's window back
+            # onto valid (mask-1) entries. Scratch positions never get a
+            # mask bit, so they are never attended.
+            self.cache_len += self.spec_k - 1
         self.prefill_batch = max(1, int(prefill_batch))
         self.steps_per_sync = max(1, int(steps_per_sync))
         self._lock = dispatch_lock
@@ -182,7 +204,7 @@ class RolloutEngine:
         # Trace counters bump INSIDE the traced bodies (the make_generate_fn
         # idiom), so they count novel shapes only: decode must stay at 1 for
         # the life of the engine — that is the one-compiled-program contract.
-        self._traces = {"decode": 0, "prefill": 0}
+        self._traces = {"decode": 0, "prefill": 0, "verify": 0}
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
         # Identity unless TRLX_TPU_SANITIZE=dispatch armed the lock we were
@@ -193,6 +215,49 @@ class RolloutEngine:
             self._decode = monitor.wrap(
                 "engine/decode_step", self._decode, phase="rollout"
             )
+        if spec:
+            from trlx_tpu.engine.drafters import make_drafter
+            from trlx_tpu.ops.decode_attention import spec_verify_supported
+
+            self.drafter = (
+                drafter
+                if drafter is not None
+                else make_drafter(spec, gen_cfg.pad_token_id)
+            )
+            # Layout blessing at arm time (CPU-checkable): the verify
+            # window's block layouts must tile so a future multi-token
+            # kernel port inherits a legal shape — see spec_verify_layout.
+            cfg = model.cfg
+            if not spec_verify_supported(
+                self.n_slots,
+                self.cache_len,
+                cfg.n_head,
+                cfg.d_model // cfg.n_head,
+                self.spec_k,
+                bool(cfg.kv_cache_quant),
+            ):
+                import warnings
+
+                warnings.warn(
+                    f"spec verify layout is not tile-legal at [S={self.n_slots}, "
+                    f"T={self.cache_len}, k={self.spec_k}] — the einsum verify "
+                    "path still runs, but a kernel port would need a new layout"
+                )
+            # Host frontier token per slot (the drafter's chaining basis) —
+            # refreshed at admit and after every verify sync.
+            self._spec_last_tok = np.zeros((self.n_slots,), dtype=np.int64)
+            self._verify = jax.jit(self._verify_fn, donate_argnums=(1,))
+            self._verify = sanitize.wrap_dispatch(
+                "engine/verify", self._verify, dispatch_lock
+            )
+            if monitor is not None:
+                self._verify = monitor.wrap(
+                    "engine/verify_step", self._verify, phase="rollout"
+                )
+        else:
+            self.drafter = None
+            self._spec_last_tok = None
+            self._verify = None
         self._reset_counters()
 
     # ------------------------------------------------------------- host side
@@ -202,6 +267,7 @@ class RolloutEngine:
         self._decode_steps = 0
         self._slot_steps = 0
         self._live_row_steps = 0
+        self._gen_tokens = 0
         self._refills = 0
         self._prefill_calls = 0
         self._completed = 0
@@ -209,6 +275,8 @@ class RolloutEngine:
         self._prefill_wall = 0.0
         self._weight_switches = 0
         self._switches_coalesced = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     def _dispatch(self):
         return self._lock if self._lock is not None else nullcontext()
@@ -220,6 +288,10 @@ class RolloutEngine:
     @property
     def num_prefill_traces(self) -> int:
         return self._traces["prefill"]
+
+    @property
+    def num_verify_traces(self) -> int:
+        return self._traces["verify"]
 
     @property
     def live_slots(self) -> int:
@@ -337,31 +409,10 @@ class RolloutEngine:
         n_live = self.live_slots
         if n_live == 0:
             return []
-        t0 = time.time()
-        with trace_span("engine/decode", slots=n_live, steps=self.steps_per_sync):
-            with self._sync_guard():
-                with self._dispatch():
-                    prev_state = self._state
-                    self._state, live_steps = self._decode(
-                        self._variables, self._state
-                    )
-                # _decode donates the slot state (donate_argnums=(1,)).
-                sanitize.mark_donated(prev_state, "engine._decode(state) [step]")
-                del prev_state
-                # device_get sits OUTSIDE the dispatch lock (blocking on the
-                # program under the lock would serialize overlap's train
-                # dispatch against decode completion) but INSIDE the sync
-                # guard: in a multi-process run this is where a dead peer
-                # host turns into an indefinite collective wait.
-                finished, n_gen, live_steps = jax.device_get(
-                    (self._state["finished"], self._state["n_gen"], live_steps)
-                )
-        self._n_gen_host = np.asarray(n_gen)
-        self._decode_wall += time.time() - t0
-        self._decode_calls += 1
-        self._decode_steps += self.steps_per_sync
-        self._slot_steps += self.steps_per_sync * self.n_slots
-        self._live_row_steps += int(live_steps)
+        if self.spec_decode:
+            finished, n_gen = self._step_verify(n_live)
+        else:
+            finished, n_gen = self._step_decode(n_live)
 
         episodes = []
         done = [
@@ -398,6 +449,16 @@ class RolloutEngine:
                     scope.record_harvest(
                         i, width, steps, (now - admit_t) if admit_t is not None else 0.0
                     )
+                    if self.spec_decode:
+                        # Per-episode accept-rate sample (accepted tokens
+                        # over window positions paid) for the /metrics
+                        # histogram, keyed by prompt bucket width like the
+                        # straggler samples.
+                        disp = int(meta.get("dispatches", 0))
+                        if disp > 0:
+                            scope.record_spec_accept(
+                                i, width, steps / float(disp * self.spec_k)
+                            )
                 rmask = np.zeros((R,), dtype=np.int32)
                 rmask[:steps] = 1
                 spans = self._build_spans(meta, steps)
@@ -415,6 +476,121 @@ class RolloutEngine:
                 self._free.append(i)
             self._completed += len(done)
         return episodes
+
+    def _step_decode(self, n_live):
+        """One non-speculative sync quantum: ``steps_per_sync`` single-token
+        decode steps in the one compiled program. Returns the host
+        (finished, n_gen) arrays for harvest."""
+        t0 = time.time()
+        with trace_span("engine/decode", slots=n_live, steps=self.steps_per_sync):
+            with self._sync_guard():
+                with self._dispatch():
+                    prev_state = self._state
+                    self._state, live_steps = self._decode(
+                        self._variables, self._state
+                    )
+                # _decode donates the slot state (donate_argnums=(1,)).
+                sanitize.mark_donated(prev_state, "engine._decode(state) [step]")
+                del prev_state
+                # device_get sits OUTSIDE the dispatch lock (blocking on the
+                # program under the lock would serialize overlap's train
+                # dispatch against decode completion) but INSIDE the sync
+                # guard: in a multi-process run this is where a dead peer
+                # host turns into an indefinite collective wait.
+                finished, n_gen, live_steps = jax.device_get(
+                    (self._state["finished"], self._state["n_gen"], live_steps)
+                )
+        self._n_gen_host = np.asarray(n_gen)
+        self._decode_wall += time.time() - t0
+        self._decode_calls += 1
+        self._decode_steps += self.steps_per_sync
+        self._slot_steps += self.steps_per_sync * self.n_slots
+        self._live_row_steps += int(live_steps)
+        self._gen_tokens += int(live_steps)
+        return finished, n_gen
+
+    def _step_verify(self, n_live):
+        """One speculative sync quantum: draft spec_k-1 tokens per slot on
+        the host, run ONE batched verify dispatch over every slot's window,
+        adopt each slot's longest accepted prefix. Dispatch accounting is
+        split: ``_decode_calls`` counts dispatches, ``_gen_tokens`` counts
+        ACCEPTED tokens only — the number every consumer of decode progress
+        (version_spans, occupancy, tokens/s) sees."""
+        K = self.spec_k
+        drafts = self._propose_drafts()
+        t0 = time.time()
+        with trace_span("engine/verify", slots=n_live, k=K):
+            with self._sync_guard():
+                with self._dispatch():
+                    prev_state = self._state
+                    self._state, accepted, window = self._verify(
+                        self._variables, self._state, self._globalize(drafts)
+                    )
+                # _verify donates the slot state (donate_argnums=(1,)).
+                sanitize.mark_donated(prev_state, "engine._verify(state) [step]")
+                del prev_state
+                finished, n_gen, accepted, window = jax.device_get(
+                    (
+                        self._state["finished"],
+                        self._state["n_gen"],
+                        accepted,
+                        window,
+                    )
+                )
+        self._n_gen_host = np.asarray(n_gen)
+        acc = np.asarray(accepted, dtype=np.int64)
+        acc_total = int(acc.sum())
+        self._decode_wall += time.time() - t0
+        self._decode_calls += 1
+        self._decode_steps += K
+        self._slot_steps += K * self.n_slots
+        self._live_row_steps += acc_total
+        self._gen_tokens += acc_total
+        self._spec_proposed += K * n_live
+        self._spec_accepted += acc_total
+        # The accepted-token total is a pure function of replicated state —
+        # fold it into the schedule fingerprint so a cross-host numerics
+        # divergence is caught by name (the crc guard) before it desyncs
+        # the admission schedule.
+        self._roll_schedule("verify", acc_total)
+        self._observe_accepted(acc, np.asarray(window))
+        return finished, n_gen
+
+    def _propose_drafts(self):
+        """Host-side drafting: the [S, K] verify windows. Column 0 is a
+        placeholder — the verify program puts the model's OWN next token
+        there (forced accept, so every live slot advances >= 1 token per
+        dispatch and a cold drafter degrades to the non-spec rate, never
+        below it). Columns 1..K-1 are the drafter's chain from each slot's
+        frontier token, shifted by one: the drafter's first prediction is
+        its guess for column 0, so its continuations land at the positions
+        they would occupy if that guess is what the model actually emits."""
+        K = self.spec_k
+        pad = int(self.gcfg.pad_token_id)
+        drafts = np.full((self.n_slots, K), pad, dtype=np.int32)
+        for i in range(self.n_slots):
+            meta = self._slot_meta[i]
+            if meta is None:
+                continue
+            chain = self.drafter.propose(i, int(self._spec_last_tok[i]), K)
+            drafts[i, 1:] = np.asarray(chain[1:], dtype=np.int32)
+            meta["dispatches"] = meta.get("dispatches", 0) + 1
+        return drafts
+
+    def _observe_accepted(self, acc, window):
+        """Fold each slot's ACCEPTED tokens back into the drafter (rejected
+        drafts are exactly what the big model disagreed with — never learn
+        from them) and advance the host frontier tokens."""
+        for i in range(self.n_slots):
+            meta = self._slot_meta[i]
+            if meta is None:
+                continue
+            a = int(acc[i])
+            if a <= 0:
+                continue
+            toks = [int(self._spec_last_tok[i])] + [int(t) for t in window[i, :a]]
+            self.drafter.observe(i, toks)
+            self._spec_last_tok[i] = toks[-1]
 
     @staticmethod
     def _build_spans(meta, steps):
@@ -537,6 +713,15 @@ class RolloutEngine:
                     "prompt_mask": msk[row],
                     "version": self.weight_version,
                 }
+                if self.spec_decode:
+                    j = int(slot)
+                    # Frontier = the last real prompt token (rows are
+                    # left-padded, so that is the final column); the drafter
+                    # table reseeds from the new occupant's prompt so a
+                    # refilled slot never inherits the previous episode's
+                    # statistics.
+                    self._spec_last_tok[j] = int(ids[row, -1])
+                    self.drafter.reset_slot(j, ids[row][msk[row] > 0].tolist())
                 if scope is not None:
                     # Slot-timeline admit: t0 (captured before the prefill
                     # dispatch) ends the slot's refill wait; the episode's
@@ -569,7 +754,9 @@ class RolloutEngine:
             "engine/slot_occupancy": self._live_row_steps / max(1, self._slot_steps),
             "engine/decode_steps": self._decode_steps,
             "engine/decode_calls": self._decode_calls,
-            "engine/gen_tokens": self._live_row_steps,
+            "engine/decode_dispatches": self._decode_calls,
+            "engine/decode_tokens": self._gen_tokens,
+            "engine/gen_tokens": self._gen_tokens,
             "engine/refills": self._refills,
             "engine/prefill_batches": self._prefill_calls,
             "engine/completed": self._completed,
@@ -577,11 +764,17 @@ class RolloutEngine:
             "engine/free_slots": len(self._free),
             "engine/decode_wall_s": self._decode_wall,
             "engine/prefill_wall_s": self._prefill_wall,
-            "engine/decode_tokens_per_s": self._live_row_steps
+            "engine/decode_tokens_per_s": self._gen_tokens
             / max(self._decode_wall, 1e-9),
             "engine/weight_switches": self._weight_switches,
             "engine/switches_coalesced": self._switches_coalesced,
         }
+        if self.spec_decode:
+            out["engine/spec_proposed"] = self._spec_proposed
+            out["engine/spec_accepted"] = self._spec_accepted
+            out["engine/spec_accept_rate"] = self._spec_accepted / max(
+                1, self._spec_proposed
+            )
         if reset:
             self._reset_counters()
         return out
@@ -623,21 +816,26 @@ class RolloutEngine:
         cfg = self.model.cfg
         S, T, R = self.n_slots, self.cache_len, int(self.gcfg.max_new_tokens)
         cache = self._pin_cache(init_cache(cfg, S, T))
-        self._state = self._globalize(
-            {
-                "cache": cache,
-                "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
-                "write_pos": jnp.zeros((S,), dtype=jnp.int32),
-                "n_gen": jnp.zeros((S,), dtype=jnp.int32),
-                "tokens": jnp.full((S, R), self.gcfg.pad_token_id, dtype=jnp.int32),
-                "active": jnp.zeros((S,), dtype=bool),
-                "finished": jnp.zeros((S,), dtype=bool),
-                "last_token": jnp.zeros((S,), dtype=jnp.int32),
-                "last_logits": jnp.zeros((S, cfg.vocab_size), dtype=jnp.float32),
-                "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
-                "rng": self._rng,
-            }
-        )
+        state = {
+            "cache": cache,
+            "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
+            "write_pos": jnp.zeros((S,), dtype=jnp.int32),
+            "n_gen": jnp.zeros((S,), dtype=jnp.int32),
+            "tokens": jnp.full((S, R), self.gcfg.pad_token_id, dtype=jnp.int32),
+            "active": jnp.zeros((S,), dtype=bool),
+            "finished": jnp.zeros((S,), dtype=bool),
+            "last_token": jnp.zeros((S,), dtype=jnp.int32),
+            "last_logits": jnp.zeros((S, cfg.vocab_size), dtype=jnp.float32),
+            "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
+            "rng": self._rng,
+        }
+        if self.spec_decode:
+            # Deferred rejection-sampling residual: the draft token the LAST
+            # verify window rejected at its break position (-1 = none). The
+            # next window's forced position 0 masks it out, which samples
+            # the exact residual distribution — see _verify_fn.
+            state["spec_resid"] = jnp.full((S,), -1, dtype=jnp.int32)
+        self._state = self._globalize(state)
 
     def _globalize(self, tree):
         """Make a host/process-local pytree a valid input for the engine's
@@ -727,30 +925,42 @@ class RolloutEngine:
         j, Pb = prompt_ids.shape
         T = self.cache_len
         R = int(self.gcfg.max_new_tokens)
+        n_soft = self.n_soft
+        Ps = Pb + n_soft  # cache rows the prefill occupies (soft prefix first)
         pm = prompt_mask.astype(jnp.int32)
+        # With soft prompts the model prepends the learned prefix itself
+        # (prepend_soft default): the mini cache carries n_soft extra rows
+        # and the cache mask marks them valid; outputs come back sliced to
+        # the prompt length, so logits_start stays Pb-1. n_soft == 0 reduces
+        # every expression here to the original prefill, same jaxpr.
+        soft_pm = (
+            jnp.concatenate([jnp.ones((j, n_soft), dtype=pm.dtype), pm], axis=1)
+            if n_soft
+            else pm
+        )
         out = self.model.apply(
             variables,
             input_ids=prompt_ids,
             attention_mask=pm,
-            cache=init_cache(cfg, j, Pb),
+            cache=init_cache(cfg, j, Ps),
             cache_index=0,
-            cache_mask=pm,
+            cache_mask=soft_pm,
             logits_start=Pb - 1,
         )
         new_cache = tuple(
             tuple(
-                big.at[slot_ids, :Pb].set(mini.astype(big.dtype))
+                big.at[slot_ids, :Ps].set(mini.astype(big.dtype))
                 for big, mini in zip(big_layer, mini_layer)
             )
             for big_layer, mini_layer in zip(state["cache"], out["cache"])
         )
         row_mask = (
-            jnp.zeros((j, T), dtype=state["cache_mask"].dtype).at[:, :Pb].set(pm)
+            jnp.zeros((j, T), dtype=state["cache_mask"].dtype).at[:, :Ps].set(soft_pm)
         )
         s = dict(state)
         s["cache"] = new_cache
         s["cache_mask"] = state["cache_mask"].at[slot_ids].set(row_mask)
-        s["write_pos"] = state["write_pos"].at[slot_ids].set(Pb)
+        s["write_pos"] = state["write_pos"].at[slot_ids].set(Ps)
         s["n_gen"] = state["n_gen"].at[slot_ids].set(0)
         s["active"] = state["active"].at[slot_ids].set(True)
         s["finished"] = state["finished"].at[slot_ids].set(False)
@@ -770,6 +980,8 @@ class RolloutEngine:
         s["last_token"] = (
             state["last_token"].at[slot_ids].set(prompt_ids[:, -1].astype(jnp.int32))
         )
+        if "spec_resid" in state:  # static: spec-armed engines only
+            s["spec_resid"] = state["spec_resid"].at[slot_ids].set(-1)
         return s
 
     def _decode_fn(self, variables, state):
@@ -867,3 +1079,168 @@ class RolloutEngine:
             length=self.steps_per_sync,
         )
         return state, live_steps
+
+    def _verify_fn(self, variables, state, drafts):
+        """ONE batched speculative verify step for ALL slots.
+
+        The window per slot is [model's own next token, draft 1..K-1]: the
+        frontier logits from the previous sync select position 0 on device
+        (greedy argmax or the rejection-sampling residual draw), so every
+        live slot is guaranteed >= 1 accepted token per dispatch. The big
+        model runs ONCE over all windows (q_len = K, vector cache_index —
+        the multi-token per-row KV path in models/lm.py), then the longest
+        accepted prefix per slot is adopted:
+
+        - greedy: position j accepts iff the draft equals argmax of the
+          processed logits after position j-1 — token-for-token equal to
+          sequential decode by construction;
+        - do_sample: standard rejection sampling against a point-mass
+          drafter: accept draft d with probability p(d). On the FIRST
+          rejection the rejected token is stored in ``spec_resid`` and the
+          residual distribution norm(p - p(d)·δ_d) is drawn at the NEXT
+          window's position 0 by masking d there — exact, because that
+          position's processed frontier logits equal this position's target.
+
+        Rollback of rejected suffixes is pure mask arithmetic: cache values
+        only matter where a ``cache_mask`` bit is 1, every future bit-set is
+        paired with a same-dispatch value write (the next window rewrites
+        [wp', wp'+K) ⊇ the stale tail), so un-setting nothing and only
+        committing bits for the accepted prefix IS the rollback — the cache
+        stays bit-consistent with the accepted stream. ``state`` is donated;
+        returns (new_state, accepted [S] int32, window [S, K] int32)."""
+        self._traces["verify"] += 1  # traced-body bump: must stay at 1
+        gcfg = self.gcfg
+        S, T, K = self.n_slots, self.cache_len, self.spec_k
+        R = int(gcfg.max_new_tokens)
+        pad = jnp.asarray(gcfg.pad_token_id, dtype=jnp.int32)
+        live = state["active"] & ~state["finished"]
+        n_gen = state["n_gen"]
+        wp = state["write_pos"]
+        keys = jax.random.split(state["rng"], K + 1)
+        rng = keys[0]
+
+        def proc(raw_logits, last_token, hidden, step_col):
+            # Same processor contract as _decode_fn: stateless per position,
+            # fresh empty carry.
+            if self.processor is not None:
+                return self.processor(
+                    raw_logits,
+                    {
+                        "last_token": last_token,
+                        "hidden": hidden,
+                        "step": step_col,
+                        "carry": {},
+                    },
+                )
+            return process_logits_default(raw_logits, gcfg, step_col)
+
+        if gcfg.eos_token_id is not None:
+            is_eos = lambda t: t == gcfg.eos_token_id  # noqa: E731
+        else:
+            is_eos = lambda t: jnp.zeros(t.shape, dtype=bool)  # noqa: E731
+
+        # ---- forced position 0: the model's own next token.
+        logits0 = proc(
+            state["last_logits"], state["last_token"], state["last_hidden"], n_gen[:, None]
+        )
+        if gcfg.do_sample:
+            resid = state["spec_resid"]
+            vocab = jnp.arange(logits0.shape[-1], dtype=jnp.int32)[None, :]
+            logits0 = jnp.where(vocab == resid[:, None], -1e9, logits0)
+            tok0 = jax.random.categorical(keys[1], logits0, axis=-1)
+        else:
+            tok0 = jnp.argmax(logits0, axis=-1)
+        tok0 = jnp.where(live, tok0.astype(jnp.int32), pad)
+        window = jnp.concatenate([tok0[:, None], drafts[:, 1:]], axis=1)
+        window = jnp.where(live[:, None], window, pad)
+
+        # ---- whole window masked BEFORE apply (each query attends to itself
+        # and its in-window predecessors; the per-row causal bias hides the
+        # future positions).
+        pos = jnp.arange(T, dtype=jnp.int32)[None, :]
+        in_window = (pos >= wp[:, None]) & (pos < (wp + K)[:, None]) & live[:, None]
+        mask_apply = jnp.maximum(
+            state["cache_mask"], in_window.astype(state["cache_mask"].dtype)
+        )
+        # Live rows never clamp (wp + K <= T by the scratch tail); dead rows'
+        # clamped writes land on their own mask-0 positions.
+        c_ix = jnp.minimum(wp, T - K)
+        out = self.model.apply(
+            variables,
+            input_ids=window,
+            attention_mask=jnp.ones((S, K), dtype=jnp.int32),
+            cache=state["cache"],
+            cache_index=c_ix,  # [S] vector: per-slot ragged frontiers
+            cache_mask=mask_apply,
+            prepend_soft=False,
+        )
+        L = out["logits"].astype(jnp.float32)  # [S, K, V]
+
+        # ---- longest-accepted-prefix chain (static python loop, K is a
+        # shape constant). acc_prev gates each position on its predecessor,
+        # so the chain breaks at the first rejection; EOS acceptance stops
+        # further accepts; the response budget clips the window tail.
+        accepted = live.astype(jnp.int32)
+        stop = live & is_eos(window[:, 0])
+        resid_new = jnp.full((S,), -1, dtype=jnp.int32)
+        acc_prev = live
+        for j in range(1, K):
+            lj = proc(
+                L[:, j - 1], window[:, j - 1], out["hidden"][:, j - 1], (n_gen + j)[:, None]
+            )
+            in_budget = (n_gen + j) < R
+            alive = acc_prev & ~stop & in_budget
+            if gcfg.do_sample:
+                p = jax.nn.softmax(lj, axis=-1)
+                p_d = jnp.take_along_axis(p, window[:, j][:, None], axis=-1)[:, 0]
+                u = jax.random.uniform(keys[j + 1], (S,))
+                match = u < p_d
+                resid_new = jnp.where(alive & ~match, window[:, j], resid_new)
+            else:
+                match = window[:, j] == jnp.argmax(lj, axis=-1).astype(jnp.int32)
+            acc_j = alive & match
+            accepted = accepted + acc_j.astype(jnp.int32)
+            stop = stop | (acc_j & is_eos(window[:, j]))
+            acc_prev = acc_j
+
+        # ---- commit the accepted prefix.
+        a = jnp.where(live, accepted, 0)
+        n_gen2 = n_gen + a
+        wp2 = wp + a
+        keep = (pos >= wp[:, None]) & (pos < wp2[:, None]) & live[:, None]
+        cache_mask2 = jnp.maximum(
+            state["cache_mask"], keep.astype(state["cache_mask"].dtype)
+        )
+
+        rpos = jnp.arange(R, dtype=jnp.int32)[None, :]
+        sel = jnp.clip(rpos - n_gen[:, None], 0, K - 1)
+        vals = jnp.take_along_axis(window, sel, axis=1)
+        put = (rpos >= n_gen[:, None]) & (rpos < n_gen2[:, None]) & live[:, None]
+        tokens2 = jnp.where(put, vals, state["tokens"])
+
+        finished2 = state["finished"] | (live & (stop | (n_gen2 >= R)))
+        ix = jnp.maximum(a - 1, 0)[:, None]  # a >= 1 for live rows
+        last_tok = jnp.take_along_axis(window, ix, axis=1)[:, 0]
+        last_logits = jnp.take_along_axis(L, ix[..., None], axis=1)[:, 0]
+        last_hidden = jnp.take_along_axis(out["hidden"], ix[..., None], axis=1)[:, 0]
+
+        new_state = dict(
+            state,
+            cache=out["cache"],
+            cache_mask=cache_mask2,
+            write_pos=wp2,
+            n_gen=n_gen2,
+            tokens=tokens2,
+            finished=finished2,
+            last_token=jnp.where(live, last_tok, state["last_token"]),
+            last_logits=jnp.where(live[:, None], last_logits, state["last_logits"]),
+            last_hidden=jnp.where(
+                live[:, None],
+                last_hidden.astype(state["last_hidden"].dtype),
+                state["last_hidden"],
+            ),
+            rng=rng,
+        )
+        if gcfg.do_sample:
+            new_state["spec_resid"] = jnp.where(live, resid_new, state["spec_resid"])
+        return new_state, a, window

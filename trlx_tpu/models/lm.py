@@ -394,11 +394,13 @@ class Attention(nn.Module):
                 and not isinstance(cache_index, (int, np.integer))
                 and jnp.ndim(cache_index) == 1
             )
-            if vector_index and q_len != 1:
-                raise ValueError(
-                    "per-row cache_index ([b] vector) requires single-token "
-                    f"decode steps, got q_len={q_len}"
-                )
+            # Vector cache_index composes with q_len > 1 (the speculative
+            # verify window): the vmap'd cache_write scatters a [b, k, ...]
+            # update at each row's own frontier, and make_attn_bias builds the
+            # per-row ragged causal bias. Rows whose frontier would run past
+            # the buffer end get their start clamped by dynamic_update_slice —
+            # callers must size the cache with a k-1 scratch tail so live rows
+            # never clamp (see RolloutEngine.cache_len).
 
             def cache_write(buf, upd):
                 # Scalar offset: one dynamic_update_slice covers the batch.
@@ -698,11 +700,23 @@ class TransformerLM(nn.Module):
                 # occupancy mask (which already includes the query slots),
                 # sliced at the write offset — NOT from the 1-token query mask.
                 full_pos = jnp.maximum(jnp.cumsum(cache_mask, axis=-1) - 1, 0)
-                if jnp.ndim(cache_index) == 1:
+                if jnp.ndim(cache_index) == 1 and q_len == 1:
                     # Per-row write offsets (slot decode, q_len == 1): each
                     # row reads the position at its own offset.
                     position_ids = jnp.take_along_axis(
                         full_pos, cache_index.astype(jnp.int32)[:, None], axis=1
+                    )
+                elif jnp.ndim(cache_index) == 1:
+                    # Per-row offsets with a multi-token query (speculative
+                    # verify window): positions at offset..offset+q_len-1 per
+                    # row, clamped so rows near the buffer tail gather in
+                    # bounds (those rows' extra slots are masked anyway).
+                    kv_len = full_pos.shape[-1]
+                    ix = cache_index.astype(jnp.int32)[:, None] + jnp.arange(
+                        q_len, dtype=jnp.int32
+                    )[None, :]
+                    position_ids = jnp.take_along_axis(
+                        full_pos, jnp.minimum(ix, kv_len - 1), axis=1
                     )
                 else:
                     position_ids = jax.lax.dynamic_slice_in_dim(full_pos, cache_index, q_len, axis=1)

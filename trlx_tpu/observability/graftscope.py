@@ -77,6 +77,7 @@ LANES = ("train", "producer", "score", "prefetch")
 REFILL_WAIT_MS_BUCKETS = (1.0, 5.0, 20.0, 50.0, 100.0, 250.0, 1000.0, 5000.0)
 LANE_GAP_S_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0)
 STRAGGLER_STEPS_BUCKETS = (4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+SPEC_ACCEPT_RATE_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 
 
 def _merge_intervals(intervals):
@@ -165,6 +166,7 @@ class GraftScope:
         self._host = []  # (t0, t1, lane)
         self._refill_wait_ms = []
         self._straggler = {}  # width -> [steps, ...] this window
+        self._spec_accept = {}  # width -> [accept rates, ...] this window
         self._slot_rows = {}  # slot -> {"busy_s", "episodes", "last_width"}
         self._fences_dropped = 0
         self._pending = queue.SimpleQueue()
@@ -253,6 +255,16 @@ class GraftScope:
             row["last_width"] = int(width)
             self._straggler.setdefault(int(width), []).append(int(steps))
 
+    def record_spec_accept(self, slot, width, rate):
+        """A spec-decode slot finished an episode with ``rate`` of its verify
+        window positions accepted (accepted tokens / (dispatches * spec_k)) —
+        the per-bucket-width accept-rate histogram sample for /metrics, same
+        keying as the straggler samples."""
+        with self._lock:
+            self._spec_accept.setdefault(int(width), []).append(
+                max(0.0, min(1.0, float(rate)))
+            )
+
     # -------------------------------------------------------------- windows
 
     def window(self):
@@ -269,6 +281,7 @@ class GraftScope:
             host, self._host = self._host, []
             refill, self._refill_wait_ms = self._refill_wait_ms, []
             straggler, self._straggler = self._straggler, {}
+            spec_accept, self._spec_accept = self._spec_accept, {}
             sanitize.race_access(self, "_fences_dropped")
             fences_dropped = self._fences_dropped
         wall = max(t1w - t0w, 1e-9)
@@ -349,6 +362,7 @@ class GraftScope:
                 "lane_gaps": lane_gaps,
                 "refill_wait_ms": refill,
                 "straggler_steps": straggler,
+                "spec_accept": spec_accept,
             }
         return gauges
 

@@ -286,6 +286,25 @@ def decode_attn_supported(B: int, T: int, h: int, d: int, quant: bool, dtype=jnp
     return ok
 
 
+def spec_verify_supported(
+    n_slots: int, T: int, h: int, d: int, spec_k: int, quant: bool
+) -> bool:
+    """CPU-runnable legality verdict for the speculative multi-token verify
+    window (tiling.spec_verify_layout over the engine's post-scratch-tail
+    cache shape). There is no multi-token Pallas kernel yet — the verify
+    program runs the einsum attention path, which lowers for any shape — so
+    this is a layout blessing, not a routing gate: the engine calls it once
+    at arm time and WARNS on an illegal layout so a future kernel port
+    inherits a shape that already tiles, instead of rediscovering the
+    BENCH_r05 failure mode. `pick_t_block` keeps the T-tail masked exactly
+    like the single-token kernel, so any cache length stays legal."""
+    from trlx_tpu.ops.tiling import is_tile_legal, spec_verify_layout
+
+    return is_tile_legal(
+        spec_verify_layout(n_slots, T, h, d, int(spec_k), bool(quant))
+    )
+
+
 def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale,
                      interpret=None, block_t=None):
     """Single-token flash-decode attention over the cache.

@@ -139,6 +139,44 @@ def slot_decode_layout(
     return decode_block_layout(n_slots, T, h, d, quant, block_t=block_t)
 
 
+def spec_verify_layout(
+    n_slots: int,
+    T: int,
+    h: int,
+    d: int,
+    spec_k: int,
+    quant: bool,
+    block_t: Optional[int] = None,
+) -> list:
+    """Block layouts of the speculative multi-token verify step
+    (trlx_tpu.engine spec decode): every slot runs the big model over a
+    [spec_k]-token draft window at its own ragged frontier, so q/out grow a
+    window axis next to the slot axis while the cache-resident operands stay
+    the slot-decode buffers. The cache T axis carries the spec_k-1 scratch
+    tail (see RolloutEngine.cache_len) — callers pass the POST-tail T so the
+    legality verdict matches the buffers that actually lower. The flash
+    decode kernel stays single-token; this layout is what the einsum verify
+    path would hand a future multi-token kernel, and the legality probe in
+    decode_attention.spec_verify_supported consumes it today so GL006 and
+    the kernel gate share one source of truth."""
+    from trlx_tpu.ops.decode_attention import pick_t_block
+
+    bt = pick_t_block(T) if block_t is None else block_t
+    layouts = [
+        BlockLayout("q", (1, spec_k, h, d), (n_slots, spec_k, h, d)),
+        BlockLayout("k_cache", (1, bt, h, d), (n_slots, T, h, d)),
+        BlockLayout("v_cache", (1, bt, h, d), (n_slots, T, h, d)),
+        BlockLayout("bias", (1, spec_k, bt), (n_slots, spec_k, T)),
+        BlockLayout("out", (1, spec_k, h, d), (n_slots, spec_k, h, d)),
+    ]
+    if quant:
+        layouts[3:3] = [
+            BlockLayout("k_scale", (1, h, bt), (n_slots, h, T)),
+            BlockLayout("v_scale", (1, h, bt), (n_slots, h, T)),
+        ]
+    return layouts
+
+
 def flash_block_layout(BH: int, T: int, D: int, bq: int, bk: int) -> list:
     """The flash-attention forward kernel's block layouts (see
     trlx_tpu.ops.flash_attention._fwd)."""

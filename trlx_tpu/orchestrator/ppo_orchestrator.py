@@ -484,6 +484,12 @@ class PPOOrchestrator(Orchestrator):
             # vs the max_new_tokens budget (early-exit savings).
             "exp_decode_tokens_per_s": gen_tokens / max(gen_s, 1e-9),
             "exp_decode_steps": float(np.mean(decode_steps)),
+            # Dispatch/token split (same keys as the engine path): the
+            # static-batch loop advances every row one token per step, so
+            # dispatches = total while-loop steps and tokens = the unpadded
+            # generated-token count.
+            "exp_decode_dispatches": float(np.sum(decode_steps)),
+            "exp_decode_tokens": float(gen_tokens),
             "exp_decode_step_budget": float(step_budget),
             # Per-EPISODE decode steps vs the per-chunk max above: their gap
             # is the straggler overhead the static batch pays (see
@@ -537,7 +543,10 @@ class PPOOrchestrator(Orchestrator):
         store = store if store is not None else rl.store
         record_staleness = bool(getattr(store, "record_staleness", False))
         timer = getattr(rl, "_phase_timer", None)
-        use_worker = bool(getattr(rl, "overlap_rollouts", False))
+        has_rm = bool(getattr(rl, "has_reward_model", False))
+        # On-device RM scoring has no host reward boundary — nothing for a
+        # score worker thread to overlap (same rule as the chunked path).
+        use_worker = bool(getattr(rl, "overlap_rollouts", False)) and not has_rm
         monitor = getattr(rl, "_health", None)
         heartbeat = getattr(rl, "heartbeat", None)
         weight_version = iter_count
@@ -603,11 +612,23 @@ class PPOOrchestrator(Orchestrator):
             # path always scores UNFUSED (full policy forward): sampled-token
             # stats never rode along with slot decode.
             nonlocal score_s, last_scores, last_kl
-            scores, reward_call = scored
             t0 = time.time()
-            logprobs, values, rewards, kl = rl.rollout_score(
-                ctx["tokens"], ctx["mask"], scores, snapshot=snapshot
-            )
+            if has_rm:
+                # On-device learned RM over the harvested chunk: policy
+                # logprobs/values, hydra ref KL, and RM scores in ONE
+                # sharded program — the same rollout_score_rm the chunked
+                # path runs, fed assembled engine episodes. ``scored`` is
+                # None on this branch (host_score never ran).
+                reward_call = None
+                logprobs, values, rewards, kl, scores = rl.rollout_score_rm(
+                    ctx["tokens"], ctx["mask"], snapshot=snapshot
+                )
+                scores = rl.to_local_host(scores)
+            else:
+                scores, reward_call = scored
+                logprobs, values, rewards, kl = rl.rollout_score(
+                    ctx["tokens"], ctx["mask"], scores, snapshot=snapshot
+                )
             logprobs, values, rewards, kl = rl.to_local_host((logprobs, values, rewards, kl))
             score_s += time.time() - t0
             span_complete("rollout/score_device", t0, step=iter_count)
@@ -729,6 +750,8 @@ class PPOOrchestrator(Orchestrator):
                         inflight.append(ctx)
                         while inflight and (len(inflight) > depth or worker.ready()):
                             finish_chunk(inflight.popleft(), worker.result())
+                    elif has_rm:
+                        finish_chunk(ctx, None)
                     else:
                         t = time.time()
                         scored = host_score((ctx["tokens_h"], ctx["mask_h"]))
@@ -792,6 +815,13 @@ class PPOOrchestrator(Orchestrator):
             "exp_decode_tokens_per_s": float(eng.get("engine/gen_tokens", 0.0))
             / max(gen_s, 1e-9),
             "exp_decode_steps": float(eng.get("engine/decode_steps", 0.0)),
+            # Dispatch/token split: with speculative decode a dispatch
+            # advances up to spec_k tokens per slot, so "steps" stops being
+            # one number — dispatches counts compiled decode/verify calls,
+            # tokens counts ACCEPTED tokens (the two coincide up to
+            # steps_per_sync batching on the non-spec path).
+            "exp_decode_dispatches": float(eng.get("engine/decode_dispatches", 0.0)),
+            "exp_decode_tokens": float(eng.get("engine/decode_tokens", 0.0)),
             "exp_decode_step_budget": float(R),
             # Same key as the chunked path: per-episode steps. Here the gap
             # to decode_step_budget is RECLAIMED by slot refill rather than

@@ -577,6 +577,19 @@ class JaxBaseTrainer(BaseRLTrainer):
                     buckets=obs_graftscope.STRAGGLER_STEPS_BUCKETS,
                     labels={"width": str(width)},
                 )
+        for width, rates in sorted((samples.get("spec_accept") or {}).items()):
+            if not rates:
+                continue
+            self.tracker.log_histogram(
+                "engine/spec_accept_rate", rates, step=self.iter_count
+            )
+            if exporter is not None:
+                exporter.observe(
+                    "engine/spec_accept_rate",
+                    rates,
+                    buckets=obs_graftscope.SPEC_ACCEPT_RATE_BUCKETS,
+                    labels={"width": str(width)},
+                )
 
     def build_trainable_mask(self, init_params):
         """Default layer-freezing mask (num_layers_unfrozen); subclasses

@@ -308,35 +308,25 @@ class PPOTrainer(JaxBaseTrainer):
         # their slot immediately and queued prompts are prefilled into them,
         # so mixed response lengths stop paying the whole-chunk straggler
         # cost. Off by default; the chunked path above stays byte-identical.
+        # Multi-host engine: the slot manager's admissions ARE
+        # data-dependent, but every input to those decisions (finished
+        # flags, n_gen, the prompt queue order) is a device-synced value
+        # identical on every host — so identical code makes identical
+        # choices and every host dispatches the same program sequence.
+        # That claim is ENFORCED, not assumed: each admission and harvest
+        # rolls into the engine's slot-schedule crc
+        # (RolloutEngine._roll_schedule), allgathered and compared at
+        # every phase boundary (resilience.distributed.
+        # verify_engine_schedule) so a divergent host is named in a
+        # HostDesync instead of deadlocking a collective; the decode sync
+        # itself runs under collective_guard(collective_deadline) as the
+        # exit-117 backstop. Soft prompts replay through the per-slot
+        # prefill (the learned prefix lands in rows [0, n_soft) of every
+        # admitted slot's cache) and has_reward_model scores harvested
+        # chunks through rollout_score_rm — both engine-compatible since
+        # the spec-decode PR, parity-tested in tests/test_spec_decode.py.
         self.rollout_engine_enabled = bool(getattr(m, "rollout_engine", False))
         self._rollout_engine = None
-        if self.rollout_engine_enabled:
-            # Multi-host engine: the slot manager's admissions ARE
-            # data-dependent, but every input to those decisions (finished
-            # flags, n_gen, the prompt queue order) is a device-synced value
-            # identical on every host — so identical code makes identical
-            # choices and every host dispatches the same program sequence.
-            # That claim is ENFORCED, not assumed: each admission and harvest
-            # rolls into the engine's slot-schedule crc
-            # (RolloutEngine._roll_schedule), allgathered and compared at
-            # every phase boundary (resilience.distributed.
-            # verify_engine_schedule) so a divergent host is named in a
-            # HostDesync instead of deadlocking a collective; the decode
-            # sync itself runs under collective_guard(collective_deadline)
-            # as the exit-117 backstop.
-            if self.model.cfg.n_soft_tokens > 0:
-                raise ValueError(
-                    "method.rollout_engine does not support soft prompts yet: "
-                    "per-slot prefill would need to replay the soft prefix on "
-                    "every admission. Use the chunked rollout path."
-                )
-            if config.model.has_reward_model:
-                raise ValueError(
-                    "method.rollout_engine does not support the on-device "
-                    "reward-model scoring path yet — episodes stream out per "
-                    "slot and are scored through the host reward_fn chunks. "
-                    "Use the chunked rollout path with has_reward_model."
-                )
 
         # On-device learned reward model: a second LM + scalar head, sharded
         # with the SAME partition rules as the policy and scored inside the
@@ -520,6 +510,8 @@ class PPOTrainer(JaxBaseTrainer):
                 processor=self._gen_processor,
                 prefill_batch=int(getattr(m, "prefill_batch", 4) or 4),
                 steps_per_sync=int(getattr(m, "engine_steps_per_sync", 8) or 8),
+                spec_decode=str(getattr(m, "spec_decode", "") or ""),
+                spec_k=int(getattr(m, "spec_k", 0) or 0),
                 dispatch_lock=self._dispatch_lock,
                 monitor=getattr(self, "_devicemon", None),
                 rng=self.next_rng(),
