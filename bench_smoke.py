@@ -33,7 +33,15 @@ Probes covering exactly what BENCH_r05 showed CPU CI was blind to:
    tokens/s than the static-batch path (the straggler steps the slot refill
    reclaims). Both rates land in BENCH_SMOKE.json.
 
-6. fleet_elastic — elastic N-worker fleet transport throughput
+6. paged_kv — the paged KV cache + prefix caching path (trlx_tpu/engine,
+   RUNBOOK §20) on a mixed-length workload whose prompts all open with the
+   same 64-token template: the paged engine must match the fixed-slot
+   engine token for token (int8 KV on and off), run >= 1.5x the slot count
+   in the SAME cache bytes (pool blocks x block size <= fixed slots x
+   cache_len), and skip the template's prefill on every admission after
+   the first (prefix hits + tokens-saved land in BENCH_SMOKE.json).
+
+7. fleet_elastic — elastic N-worker fleet transport throughput
    (trlx_tpu/fleet, RUNBOOK §18): threaded workers with a fixed synthetic
    produce cost drive the real lease ledger + per-worker stream indexes +
    exactly-once intake at 1 worker then 2. Intake must stay exactly-once
@@ -582,6 +590,172 @@ def _spec_decode_probe_meshless():
     }
 
 
+def paged_kv_probe():
+    from trlx_tpu.parallel import mesh as mesh_mod
+
+    # Meshless for the same reason as decode_engine_probe: the engine pins
+    # its slot state to the process-global mesh left by earlier probes.
+    prev_mesh = mesh_mod.peek_mesh()
+    mesh_mod.set_mesh(None)
+    try:
+        return _paged_kv_probe_meshless()
+    finally:
+        mesh_mod.set_mesh(prev_mesh)
+
+
+def _paged_kv_probe_meshless():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.engine import RolloutEngine
+    from trlx_tpu.models import LMConfig, LMWithValueHead
+    from trlx_tpu.ops.sampling import (
+        GenerateConfig,
+        make_bigram_mask_processor,
+        process_logits_default,
+    )
+
+    # Paged KV + prefix caching (ISSUE 20): a mixed-length workload where
+    # every prompt opens with the SAME 64-token template (the RLHF shape:
+    # one system/task preamble, per-episode suffix). Three claims, each
+    # gated here:
+    #   1. parity — the paged engine with prefix caching ON returns
+    #      token-for-token the fixed-slot engine's episodes (quant on/off);
+    #   2. capacity — the paged pool runs MORE concurrent slots in the SAME
+    #      cache bytes: S_paged >= 1.5 x S_fixed with
+    #      n_blocks*block_size <= S_fixed*cache_len (same per-token layout,
+    #      so token-slots ARE bytes);
+    #   3. prefix savings — template blocks prefill ONCE per weight version;
+    #      every later admission pins them and dispatches a suffix-only
+    #      prefill (64 of 72 prompt tokens skipped per hit).
+    # Forced-bigram chain (as in decode_engine_probe) engineers response
+    # lengths: one straggler (16 steps) per wave, short rows run 5.
+    V, R, W, TPL, BS = 64, 16, 72, 64, 16
+    eos, pad = V - 1, 0
+    S_FIXED, S_PAGED = 4, 6
+    cache_len = W + R  # 88 -> 6 blocks of 16 per slot (kv_len 96)
+    POOL_BLOCKS = (S_FIXED * cache_len) // BS  # 22: byte-parity with fixed
+    gcfg = GenerateConfig(max_new_tokens=R, do_sample=False, eos_token_id=eos, pad_token_id=pad)
+    forbidden = np.ones((V, V), dtype=bool)
+    for i in range(V):
+        forbidden[i, (i + 1) % V] = False
+    bigram = make_bigram_mask_processor(jnp.asarray(forbidden))
+
+    def proc(logits, state):
+        return process_logits_default(bigram(logits, state), gcfg, state["step"])
+
+    # 12 rows = 2 waves of 6: shared template, unique 8-token suffixes, the
+    # suffix's last token engineering the response length.
+    prng = np.random.default_rng(5)
+    template = prng.integers(1, 40, size=TPL).astype(np.int32)
+    ids = np.tile(template, (12, 1))
+    suffix = prng.integers(1, 40, size=(12, W - TPL)).astype(np.int32)
+    suffix[:, -1] = eos - 5  # short rows: 5 steps
+    suffix[0, -1] = eos - R  # wave stragglers: full 16-step budget
+    suffix[6, -1] = eos - R
+    ids = np.concatenate([ids, suffix], axis=1)
+    msk = np.ones_like(ids)
+
+    def run(quant, paged):
+        cfg = LMConfig(
+            vocab_size=V, n_layer=2, n_head=2, d_model=64, max_position=128,
+            dtype="float32", kv_cache_quant=quant,
+        )
+        model = LMWithValueHead(cfg)
+        params = {"params": model.init(
+            jax.random.PRNGKey(0), jnp.ones((2, W), jnp.int32), jnp.ones((2, W), jnp.int32)
+        )["params"]}
+        kw = dict(paged_kv=True, kv_block_size=BS, kv_pool_blocks=POOL_BLOCKS) if paged else {}
+        engine = RolloutEngine(
+            model, gcfg, n_slots=S_PAGED if paged else S_FIXED, prompt_width=W,
+            processor=proc, prefill_batch=1, steps_per_sync=1,
+            rng=jax.random.PRNGKey(3), **kw,
+        )
+        engine.update_weights(params, version=0)
+        # warm the compiled programs off the clock (full-width prefill, the
+        # suffix-only prefill shape, and decode)
+        engine.submit(ids[:2], msk[:2])
+        while not engine.idle:
+            engine.step()
+        engine.stats(reset=True)
+        # pool hit counters are lifetime totals by contract — diff across
+        # the timed window so the warm-up's hit does not inflate the claim
+        base = {k: v for k, v in engine.stats(reset=False).items()
+                if k.endswith("_total")} if paged else {}
+        episodes, peak = [], 0
+        t0 = time.time()
+        engine.submit(ids, msk)
+        while not engine.idle:
+            episodes.extend(engine.step())
+            if paged:
+                peak = max(peak, engine.pool.used_blocks())
+        wall = time.time() - t0
+        stats = engine.stats(reset=False)
+        for k, v in base.items():
+            stats[k] = stats[k] - v
+        if paged:
+            engine.abort()  # leak_audit: every pool block accounted for
+        engine.shutdown()
+        return episodes, stats, peak, wall
+
+    result = {
+        "slots_fixed": S_FIXED,
+        "slots_paged": S_PAGED,
+        "slot_capacity_ratio": round(S_PAGED / S_FIXED, 2),
+        "cache_tokens_fixed": S_FIXED * cache_len,
+        "cache_tokens_paged": POOL_BLOCKS * BS,
+        "block_size": BS,
+        "pool_blocks": POOL_BLOCKS,
+        "template_tokens": TPL,
+    }
+    # claim 2 is pure arithmetic — pin it before paying for any run
+    assert POOL_BLOCKS * BS <= S_FIXED * cache_len
+    assert S_PAGED >= 1.5 * S_FIXED
+    t_all = time.time()
+    for quant in (False, True):
+        fixed_eps, _, _, _ = run(quant, paged=False)
+        paged_eps, stats, peak, wall = run(quant, paged=True)
+        assert len(fixed_eps) == len(paged_eps) == 12
+        ref = {tuple(e.prompt_ids.tolist()): e for e in fixed_eps}
+        for ep in paged_eps:
+            r = ref[tuple(ep.prompt_ids.tolist())]
+            assert np.array_equal(ep.response_ids, r.response_ids), (
+                f"paged/fixed token mismatch (quant={quant})"
+            )
+            assert np.array_equal(ep.response_mask, r.response_mask), (
+                f"paged/fixed mask mismatch (quant={quant})"
+            )
+        # claim 3: the warm-up registered the template at this weight
+        # version, so ALL 12 timed admissions hit and skip TPL tokens of
+        # prefill each (prefill_batch=1 admits one row per call — even on a
+        # cold registry the second admission would see the first's entry).
+        hits = stats["engine/prefix_hits_total"]
+        saved = stats["engine/prefill_tokens_saved_total"]
+        assert hits >= 12, f"prefix hits {hits} < 12 (quant={quant})"
+        assert saved >= 12 * TPL, f"prefill tokens saved {saved} < {12 * TPL}"
+        assert peak <= POOL_BLOCKS - 1, f"pool peak {peak} blocks overflows"
+        frag = stats["engine/pool_frag_frac"]
+        assert 0.0 <= frag <= 1.0
+        key = "int8" if quant else "fp"
+        result[key] = {
+            "prefix_hits": int(hits),
+            "prefill_tokens_saved": int(saved),
+            "prefill_token_reduction": round(saved / float(12 * W), 3),
+            "peak_pool_blocks": int(peak),
+            "evictions": int(stats["engine/pool_evictions_total"]),
+            "decode_tokens_per_s": round(stats["engine/decode_tokens_per_s"], 1),
+            "wall_s": round(wall, 2),
+        }
+    # headline fields for the trajectory fold: worst case over quant modes
+    result["prefix_hits_total"] = min(result["fp"]["prefix_hits"], result["int8"]["prefix_hits"])
+    result["prefill_token_reduction"] = min(
+        result["fp"]["prefill_token_reduction"], result["int8"]["prefill_token_reduction"]
+    )
+    result["seconds"] = round(time.time() - t_all, 2)
+    return result
+
+
 def fleet_elastic_probe():
     """Elastic fleet transport throughput: episode batches/s through the
     REAL lease ledger + per-worker stream indexes + exactly-once intake
@@ -707,6 +881,7 @@ def main():
         ("fused_loss", fused_loss_probe),
         ("decode_engine", decode_engine_probe),
         ("spec_decode", spec_decode_probe),
+        ("paged_kv", paged_kv_probe),
         ("fleet_elastic", fleet_elastic_probe),
     ):
         manifest.heartbeat("probe", candidate=name)
@@ -724,6 +899,13 @@ def main():
     spec = result["spec_decode"]
     assert {"speedup_vs_nonspec", "accept_rate", "decode_dispatches", "decode_tokens"} <= set(spec), (
         f"spec_decode record must pair speedup with accept rate + dispatch split: {spec}"
+    )
+    # A slot-capacity ratio is only meaningful next to the byte budget it
+    # was achieved in and the prefix savings that funded it.
+    paged = result["paged_kv"]
+    assert {"slot_capacity_ratio", "cache_tokens_fixed", "cache_tokens_paged",
+            "prefix_hits_total", "prefill_token_reduction"} <= set(paged), (
+        f"paged_kv record must pair capacity ratio with bytes + prefix savings: {paged}"
     )
     result["wall_s"] = round(time.time() - t0, 1)
     with open(OUT, "w") as f:

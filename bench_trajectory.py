@@ -13,8 +13,11 @@ Inputs (all already tracked in the repo root):
   recorded as gaps, not silently dropped.
 - ``BENCH_SMOKE.json`` — the CPU smoke's informational throughputs
   (rollout/fused-loss tokens/s, overlap fraction). Folded into the series
-  for trend reading, never gated: CPU smoke numbers measure the harness,
-  not the hardware.
+  for trend reading; throughputs are never gated (CPU smoke numbers
+  measure the harness, not the hardware). The one exception is the paged
+  KV record's CONTRACT fields — slot-capacity ratio and prefix prefill
+  savings are hardware-independent invariants, so the gate fails when
+  they fall below the 1.5x / >0 floors.
 - ``BENCH_MANIFEST.jsonl`` / ``BENCH_MANIFEST_rNN.jsonl`` — bench.py's
   crash-proof RunManifest journal (observability/graftscope). For runs
   whose artifact carries no data, the manifest's forensic reason (which
@@ -214,6 +217,13 @@ def _parse_smoke(path: str):
             out["spec_accept_rate"] = float(spec["accept_rate"])
         if isinstance(spec.get("speedup_vs_nonspec"), (int, float)):
             out["spec_speedup_vs_nonspec"] = float(spec["speedup_vs_nonspec"])
+    paged = smoke.get("paged_kv", {})
+    if isinstance(paged.get("slot_capacity_ratio"), (int, float)):
+        out["paged_slot_capacity_ratio"] = float(paged["slot_capacity_ratio"])
+        if isinstance(paged.get("prefill_token_reduction"), (int, float)):
+            out["paged_prefill_token_reduction"] = float(paged["prefill_token_reduction"])
+        if isinstance(paged.get("prefix_hits_total"), (int, float)):
+            out["paged_prefix_hits_total"] = float(paged["prefix_hits_total"])
     fleet = smoke.get("fleet_elastic", {})
     if isinstance(fleet.get("episodes_per_s_2workers"), (int, float)):
         out["fleet_episodes_per_s_2workers"] = float(fleet["episodes_per_s_2workers"])
@@ -238,6 +248,27 @@ def build_trajectory(
         "regressed": False,
         "verdict": [],
     }
+    # Paged-KV gate (the one smoke-sourced gate): the capacity ratio and
+    # prefix savings are CONTRACTS, not throughputs — a smoke artifact that
+    # stops carrying >= 1.5x slots in the same bytes, or stops saving
+    # prefill on template hits, means the paged path regressed regardless
+    # of what hardware produced the file.
+    smoke = trajectory["smoke"] or {}
+    if "paged_slot_capacity_ratio" in smoke:
+        ratio = smoke["paged_slot_capacity_ratio"]
+        saving = smoke.get("paged_prefill_token_reduction", 0.0)
+        if ratio < 1.5 or saving <= 0.0:
+            trajectory["regressed"] = True
+            trajectory["verdict"].append(
+                f"REGRESSION: paged KV smoke carries slot capacity {ratio:.2f}x "
+                f"(floor 1.5x) with prefill-token reduction {saving:.3f} — the "
+                "paged pool no longer buys slots/prefill in the same cache bytes"
+            )
+        else:
+            trajectory["verdict"].append(
+                f"paged KV: {ratio:.2f}x slots in the same cache bytes, "
+                f"{saving:.0%} prefill tokens saved by prefix hits — ok"
+            )
     if not with_data:
         trajectory["verdict"].append("no bench runs carry data — nothing to gate")
         return trajectory

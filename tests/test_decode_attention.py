@@ -153,3 +153,100 @@ def test_supported_probe_is_cached_and_safe_off_tpu():
     # count must not grow)
     assert decode_attn_supported(32, 832, 16, 256, True)
     assert len(da._PROBE_CACHE) == 1
+
+
+# ------------------------------------------------------------- paged kernel
+
+
+def _paged_setup(B=32, h=16, d=256, bs=32, bps=4, seed=7, share=True):
+    """A shared block pool + per-row tables exercising every row class the
+    engine produces: tile-aligned valid spans, ragged mid-block frontiers,
+    a fully-masked (dead) row, spans crossing block boundaries, and —
+    when ``share`` — two rows aliasing the SAME physical prefix block
+    (the prefix-cache hit layout)."""
+    rng = np.random.default_rng(seed)
+    T = bps * bs
+    n_blocks = 1 + B * bps  # block 0 = the engine's trash block
+    q = rng.normal(size=(B, h, d)).astype(np.float32)
+    k_pool = rng.normal(size=(n_blocks, bs, h, d)).astype(np.float32)
+    v_pool = rng.normal(size=(n_blocks, bs, h, d)).astype(np.float32)
+    tables = np.arange(1, 1 + B * bps, dtype=np.int32).reshape(B, bps)
+    if share:
+        # rows 1..3 alias row 0's first block — prefix-cache sharing
+        tables[1:4, 0] = tables[0, 0]
+    # shuffle physical placement so virtual order != physical order
+    perm = rng.permutation(np.unique(tables))
+    remap = dict(zip(np.unique(tables).tolist(), perm.tolist()))
+    tables = np.vectorize(remap.get)(tables).astype(np.int32)
+    valid = np.ones((B, T), dtype=bool)
+    valid[0, : bs] = False              # left pad = exactly one block
+    valid[1, : bs // 2] = False         # left pad mid-block (ragged head)
+    valid[2, T - bs - 3 :] = False      # frontier crosses into the last block
+    valid[3, T - 1 :] = False           # frontier one short of full
+    valid[4, :] = False                 # dead slot: fully masked
+    valid[5, bs - 1 : 2 * bs + 1] = False  # hole spanning a block boundary
+    bias = np.where(valid, 0.0, -1e9).astype(np.float32)
+    return q, k_pool, v_pool, tables, bias
+
+
+def _paged_reference(q, k_pool, v_pool, tables, bias, scale):
+    """Gather-then-einsum: the model layer's paged fallback math."""
+    B, bps = tables.shape
+    bs = k_pool.shape[1]
+    k = k_pool[tables].reshape(B, bps * bs, *k_pool.shape[2:])
+    v = v_pool[tables].reshape(B, bps * bs, *v_pool.shape[2:])
+    return _reference_einsum(q, k, v, bias, scale)
+
+
+@pytest.mark.parametrize("share", (False, True))
+def test_paged_plain_matches_gathered_einsum(share):
+    from trlx_tpu.ops.decode_attention import paged_decode_attention
+
+    q, k_pool, v_pool, tables, bias = _paged_setup(share=share)
+    out = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool), None, None,
+        jnp.asarray(tables), jnp.asarray(bias), scale=0.0625, interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+    ref = _paged_reference(q, k_pool, v_pool, tables, bias, 0.0625)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_paged_quant_matches_dequantized_gathered_einsum():
+    from trlx_tpu.ops.decode_attention import paged_decode_attention
+
+    q, k_pool, v_pool, tables, bias = _paged_setup(seed=8)
+    kq, ks = quantize_kv(jnp.asarray(k_pool))
+    vq, vs = quantize_kv(jnp.asarray(v_pool))
+    out = paged_decode_attention(
+        jnp.asarray(q), kq, vq, ks, vs,
+        jnp.asarray(tables), jnp.asarray(bias), scale=0.0625, interpret=True,
+    )
+    k_dq = np.asarray(kq.astype(jnp.float32) * ks[..., None].astype(jnp.float32))
+    v_dq = np.asarray(vq.astype(jnp.float32) * vs[..., None].astype(jnp.float32))
+    ref = _paged_reference(q, k_dq, v_dq, tables, bias, 0.0625)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_eligibility_gate():
+    from trlx_tpu.ops.decode_attention import paged_decode_eligible
+
+    # off-TPU the gate must refuse (the gathered einsum stands in CI)
+    on_tpu = jax.default_backend() == "tpu"
+    assert paged_decode_eligible(16, 256, 128, 8, True) == on_tpu
+    if on_tpu:  # pragma: no cover — CPU CI
+        # the bias tile: block_size % 128 unless the slot is one block
+        assert not paged_decode_eligible(16, 256, 96, 8, True)
+        assert paged_decode_eligible(16, 256, 96, 1, True)
+        assert not paged_decode_eligible(16, 200, 128, 8, True)
+        assert not paged_decode_eligible(3, 256, 128, 8, True)
+
+
+def test_paged_supported_probe_is_cached_and_safe_off_tpu():
+    from trlx_tpu.ops import decode_attention as da
+
+    da._PROBE_CACHE.clear()
+    assert da.paged_decode_supported(32, 257, 128, 8, 16, 256, True)
+    n = len(da._PROBE_CACHE)
+    assert da.paged_decode_supported(32, 257, 128, 8, 16, 256, True)
+    assert len(da._PROBE_CACHE) == n  # pure cache hit

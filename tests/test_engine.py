@@ -826,3 +826,165 @@ def test_engine_slot_timeline_events_ordered_and_rolled_up(tmp_path):
     assert sum(row["episodes"] for row in snap["slots"]) == 6
     assert all(row["busy_s"] >= 0.0 for row in snap["slots"])
     assert {row["slot"] for row in snap["slots"]} == {0, 1}
+
+
+# --------------------------------------------------------- paged KV cache
+
+
+def _paged_prompts():
+    """7 width-6 rows, two of which duplicate row 0 exactly (ids AND mask)
+    — the prefix-cache hit candidates at kv_block_size=4."""
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(2, 23, size=(7, 6)).astype(np.int32)
+    pmask = np.ones((7, 6), np.int32)
+    prompts[1, :2] = 0
+    pmask[1, :2] = 0
+    prompts[5] = prompts[0]
+    prompts[6] = prompts[0]
+    return prompts, pmask
+
+
+def _paged_pair(quant, *, spec="", paged_kwargs=None):
+    """A (fixed, paged) engine pair over the same tiny model/weights."""
+    cfg = LMConfig(
+        vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=96,
+        dtype="float32", kv_cache_quant=quant,
+    )
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (3, 6), 2, cfg.vocab_size)
+    params = {"params": model.init(rng, ids, jnp.ones((3, 6), jnp.int32))["params"]}
+    gcfg = GenerateConfig(
+        max_new_tokens=7, do_sample=False, pad_token_id=0, eos_token_id=1
+    )
+    kw = dict(n_slots=3, prompt_width=6, prefill_batch=2, steps_per_sync=3)
+    if spec:
+        kw.update(spec_decode=spec, spec_k=3)
+    # fresh rng arrays per engine: decode donates the slot state, and the
+    # key rides in it — a shared array would be deleted under the 2nd engine
+    fixed = RolloutEngine(model, gcfg, **kw, rng=jax.random.PRNGKey(2))
+    paged = RolloutEngine(
+        model, gcfg, **kw, rng=jax.random.PRNGKey(2), paged_kv=True,
+        **(paged_kwargs or {"kv_block_size": 4}),
+    )
+    for e in (fixed, paged):
+        e.update_weights(params, version=1)
+    return fixed, paged
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_engine_token_parity_with_prefix_hits(quant):
+    """The tentpole acceptance: the paged engine with prefix caching ON is
+    token-for-token identical to the fixed-slot engine on a mixed workload
+    with duplicate prompts, int8 KV on and off — and actually HITS (the dup
+    rows skip their shared prefix's prefill), with a clean pool at the end."""
+    prompts, pmask = _paged_prompts()
+    fixed, paged = _paged_pair(quant)
+    for e in (fixed, paged):
+        e.submit(prompts, pmask)
+    ref = {tuple(x.prompt_ids.tolist()): x for x in _drain(fixed)}
+    got = {tuple(x.prompt_ids.tolist()): x for x in _drain(paged)}
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k].response_ids, got[k].response_ids)
+        np.testing.assert_array_equal(ref[k].response_mask, got[k].response_mask)
+    assert paged.num_decode_traces == 1, "paged decode retraced"
+    st = paged.stats()
+    assert st["engine/prefix_hits_total"] >= 1
+    assert st["engine/prefill_tokens_saved_total"] >= 4
+    assert 0.0 <= st["engine/pool_frag_frac"] <= 1.0
+    paged.pool.leak_audit(expect_idle=True)
+    fixed.shutdown()
+    paged.shutdown()
+
+
+def test_paged_engine_spec_decode_parity():
+    """Satellite: paged_kv composes with spec_decode — the verify windows
+    write through the block table (scratch tail in the slot's last block)
+    and greedy output stays token-for-token equal to the non-paged spec
+    engine."""
+    prompts, pmask = _paged_prompts()
+    fixed, paged = _paged_pair(False, spec="ngram")
+    for e in (fixed, paged):
+        e.submit(prompts, pmask)
+    ref = {tuple(x.prompt_ids.tolist()): x for x in _drain(fixed)}
+    got = {tuple(x.prompt_ids.tolist()): x for x in _drain(paged)}
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k].response_ids, got[k].response_ids)
+    assert paged.num_verify_traces == 1, "paged verify retraced"
+    # the scratch tail rounded INTO the last block: kv_len covers cache_len
+    assert paged.kv_len >= paged.cache_len
+    assert paged.stats()["engine/prefix_hits_total"] >= 1
+    paged.pool.leak_audit(expect_idle=True)
+    fixed.shutdown()
+    paged.shutdown()
+
+
+def test_paged_engine_undersized_pool_requeues_and_drains():
+    """A pool too small for all slots at once (2 spans for 3 slots) must
+    requeue pool-bound admissions and still drain the whole workload —
+    transactional admission, no deadlock, no leak."""
+    prompts, pmask = _paged_prompts()
+    _, paged = _paged_pair(
+        False, paged_kwargs={"kv_block_size": 4, "kv_pool_blocks": 1 + 2 * 4}
+    )
+    paged.submit(prompts, pmask)
+    eps = _drain(paged)
+    assert len(eps) == 7
+    paged.pool.leak_audit(expect_idle=True)
+    paged.shutdown()
+
+
+def test_paged_engine_abort_releases_all_blocks():
+    """Satellite: abort() mid-decode releases every pinned/private block
+    (leak_audit inside abort raises otherwise) and repoints the device
+    tables at the trash block."""
+    prompts, pmask = _paged_prompts()
+    _, paged = _paged_pair(False)
+    paged.submit(prompts, pmask)
+    paged.step()  # slots mid-decode: blocks pinned and referenced
+    assert paged.pool.used_blocks() > 0
+    paged.abort()
+    assert paged.pool.used_blocks() == 0
+    assert not np.asarray(jax.device_get(paged._state["block_tables"])).any()
+    paged.shutdown()
+
+
+def test_paged_kv_off_leaves_engine_byte_identical():
+    """The default-off contract: an engine with paged_kv=False is the SAME
+    engine as one built before the paged knobs existed — no block tables in
+    the slot state, no pool, kv_len == cache_len, and a bit-identical
+    decode jaxpr (the gather-indirection must vanish at trace time, not
+    just at runtime)."""
+    cfg = LMConfig(
+        vocab_size=23, n_layer=2, n_head=2, d_model=32, max_position=96,
+        dtype="float32",
+    )
+    model = LMWithValueHead(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (3, 6), 2, cfg.vocab_size)
+    params = {"params": model.init(rng, ids, jnp.ones((3, 6), jnp.int32))["params"]}
+    gcfg = GenerateConfig(max_new_tokens=7, do_sample=False, pad_token_id=0, eos_token_id=1)
+    kw = dict(n_slots=3, prompt_width=6, prefill_batch=2, steps_per_sync=3)
+    default = RolloutEngine(model, gcfg, **kw, rng=jax.random.PRNGKey(2))
+    off = RolloutEngine(model, gcfg, **kw, rng=jax.random.PRNGKey(2),
+                        paged_kv=False, kv_block_size=4,
+                        kv_pool_blocks=99)  # knobs present but off
+    assert off.pool is None and off.kv_len == off.cache_len
+    for e in (default, off):
+        e.update_weights(params, version=1)
+        e._adopt_staged()  # weights are staged until the next step() top
+        e._ensure_state()
+    assert "block_tables" not in off._state
+    assert jax.tree_util.tree_structure(default._state) == jax.tree_util.tree_structure(off._state)
+    j_default = jax.make_jaxpr(default._decode_fn)(default._variables, default._state)
+    j_off = jax.make_jaxpr(off._decode_fn)(off._variables, off._state)
+    # identical programs modulo the memory addresses of callables embedded
+    # in eqn params (two engine instances -> two bound-method objects)
+    import re
+
+    strip = lambda s: re.sub(r"0x[0-9a-f]+", "0x", str(s))  # noqa: E731
+    assert strip(j_default) == strip(j_off)
+    default.shutdown()
+    off.shutdown()

@@ -184,6 +184,15 @@ elif mode in ("engine", "engine_spec", "engine_kill"):
     if mode == "engine_spec":
         config.method.spec_decode = "ngram"
         config.method.spec_k = 3
+    if mode in ("engine_spec", "engine_kill"):
+        # Paged KV armed: these drills double as POOL LEAK drills. Trainer
+        # teardown runs engine.abort(), whose BlockPool.leak_audit raises a
+        # named RuntimeError on any lost/double-freed block — so the DONE
+        # marker below is unreachable if the fleet path leaks pool blocks,
+        # and the pool's table rows fold into the same slot-schedule crc
+        # the per-phase check verifies across hosts.
+        config.method.paged_kv = True
+        config.method.kv_block_size = 4
     trlx_tpu.train(
         reward_fn=reward_fn, prompts=prompts, eval_prompts=eval_prompts,
         metric_fn=metric_fn, config=config, logit_mask=logit_mask,
@@ -438,7 +447,10 @@ def test_fleet_drill_engine_spec_two_process_clean(tmp_path):
     identical proposals on every host (same prompt set, same accepted
     stream), every verify dispatch folds its accepted-token total into the
     slot-schedule crc, and the per-phase crc check stays clean — speculation
-    does not desync the slot managers."""
+    does not desync the slot managers. The leg also arms method.paged_kv:
+    every admission's block-table row folds into the same crc (identical
+    allocators on identical streams), and teardown's pool leak_audit makes
+    the DONE marker unreachable if spec verify windows leaked pool blocks."""
     procs, ckpt = _launch(tmp_path, "engine_spec", {})
     outs = _communicate(procs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
@@ -483,7 +495,9 @@ def test_fleet_drill_mid_decode_host_kill_exit117_with_slot_states(tmp_path):
     slots mid-decode → host 0 hits its guarded cross-host engine sync, the
     collective_guard converts the dead peer into exit 117, and the fleet
     incident bundle names the wedged engine collective AND carries host 0's
-    per-slot states at abort time."""
+    per-slot states at abort time. Runs with method.paged_kv armed: the
+    kill lands with pool blocks pinned mid-decode, and the survivor's
+    teardown must not trip the pool leak audit on its way to the bundle."""
     procs, ckpt = _launch(tmp_path, "engine_kill", {1: "mid_decode_host_kill@2"})
     try:
         out0, _ = procs[0].communicate(timeout=900)

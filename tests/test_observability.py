@@ -724,3 +724,61 @@ def test_bench_trajectory_parses_spec_decode_smoke_section(tmp_path):
     out = bench_trajectory._parse_smoke(path)
     assert "spec_decode_tokens_per_s" not in out
     assert out["rollout_tokens_per_s"] == 5.0
+
+
+def test_bench_trajectory_parses_and_gates_paged_kv_section(tmp_path):
+    """The paged-KV record's capacity ratio and prefix savings are
+    hardware-independent CONTRACTS: the smoke fold must surface them, and
+    build_trajectory must flip ``regressed`` when either falls below its
+    floor (1.5x slots in the same bytes, >0 prefill reduction) — the one
+    smoke-sourced gate. Pre-PR-20 artifacts (no section) stay silent."""
+    import bench_trajectory
+
+    path = str(tmp_path / "BENCH_SMOKE.json")
+    good = {
+        "paged_kv": {
+            "slot_capacity_ratio": 1.5,
+            "prefill_token_reduction": 0.889,
+            "prefix_hits_total": 12,
+        }
+    }
+    with open(path, "w") as f:
+        json.dump(good, f)
+    out = bench_trajectory._parse_smoke(path)
+    assert out["paged_slot_capacity_ratio"] == 1.5
+    assert out["paged_prefill_token_reduction"] == 0.889
+    assert out["paged_prefix_hits_total"] == 12
+
+    traj = bench_trajectory.build_trajectory(
+        [], smoke_path=path, manifest_path="missing.jsonl"
+    )
+    assert traj["regressed"] is False
+    assert any("paged KV" in v and "ok" in v for v in traj["verdict"])
+
+    # capacity below the floor -> gate trips even with no bench runs
+    good["paged_kv"]["slot_capacity_ratio"] = 1.2
+    with open(path, "w") as f:
+        json.dump(good, f)
+    traj = bench_trajectory.build_trajectory(
+        [], smoke_path=path, manifest_path="missing.jsonl"
+    )
+    assert traj["regressed"] is True
+    assert any("REGRESSION: paged KV" in v for v in traj["verdict"])
+
+    # savings gone -> same trip
+    good["paged_kv"].update(slot_capacity_ratio=1.5, prefill_token_reduction=0.0)
+    with open(path, "w") as f:
+        json.dump(good, f)
+    assert bench_trajectory.build_trajectory(
+        [], smoke_path=path, manifest_path="missing.jsonl"
+    )["regressed"] is True
+
+    # absent section: no paged fields, no paged verdict
+    with open(path, "w") as f:
+        json.dump({"rollout": {"tokens_per_s": 5.0}}, f)
+    traj = bench_trajectory.build_trajectory(
+        [], smoke_path=path, manifest_path="missing.jsonl"
+    )
+    assert "paged_slot_capacity_ratio" not in traj["smoke"]
+    assert traj["regressed"] is False
+    assert not any("paged" in v for v in traj["verdict"])

@@ -1,0 +1,240 @@
+"""BlockPool: the host-side allocator behind the paged KV cache
+(trlx_tpu/engine/paged_pool.py).
+
+Fast tier — pure host bookkeeping, no device. Covers: transactional
+admission with full worst-case commitment, chained prefix digests and the
+share-iff-bit-identical rule, pin/refcount lifecycle across overlapping
+slots, the diverge-means-stop-sharing (copy-on-write without the copy)
+layout, LRU eviction of warm templates, version-flush semantics, and the
+leak audit the engine runs at abort/shutdown."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.engine.paged_pool import (
+    TRASH_BLOCK,
+    BlockPool,
+    PoolExhausted,
+    prefix_block_digests,
+)
+
+
+def _row(*toks):
+    ids = np.asarray(toks, dtype=np.int32)
+    return ids, np.ones_like(ids)
+
+
+# --------------------------------------------------------------- digests
+
+
+def test_digests_are_chained_and_content_addressed():
+    ids, msk = _row(*range(8))
+    d = prefix_block_digests(ids, msk, 4, 8)
+    assert len(d) == 2  # only FULL blocks digest
+    # same content -> same chain
+    assert prefix_block_digests(ids.copy(), msk.copy(), 4, 8) == d
+    # block 1's digest commits to block 0: editing block 0 changes BOTH
+    ids2 = ids.copy()
+    ids2[0] += 1
+    d2 = prefix_block_digests(ids2, msk, 4, 8)
+    assert d2[0] != d[0] and d2[1] != d[1]
+    # mask is content too (left padding participates)
+    msk2 = msk.copy()
+    msk2[1] = 0
+    assert prefix_block_digests(ids, msk2, 4, 8)[0] != d[0]
+    # cap respects n_blocks_max
+    assert len(prefix_block_digests(ids, msk, 4, 1)) == 1
+
+
+# -------------------------------------------------------------- admission
+
+
+def test_admit_allocates_full_span_and_release_frees():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_slot=2, n_slots=4)
+    ids, msk = _row(*range(8))
+    row, hit = pool.admit(0, 1, ids, msk)
+    assert hit == 0 and row.shape == (2,)
+    assert TRASH_BLOCK not in row
+    assert pool.used_blocks() == 2 and pool.available() == 6
+    assert (pool.tables[0] == row).all()
+    pool.leak_audit()
+    pool.release(0)
+    assert pool.used_blocks() == 0 and pool.available() == 8
+    assert (pool.tables[0] == TRASH_BLOCK).all()
+    pool.leak_audit(expect_idle=True)
+
+
+def test_admit_is_transactional_on_exhaustion():
+    pool = BlockPool(n_blocks=5, block_size=4, blocks_per_slot=2, n_slots=4)
+    pool.admit(0, 1, *_row(*range(8)))
+    pool.admit(1, 1, *_row(*range(100, 108)))
+    free_before = list(pool.free)
+    with pytest.raises(PoolExhausted):
+        pool.admit(2, 1, *_row(*range(200, 208)))
+    # nothing mutated: free list, refcounts, tables all unchanged
+    assert pool.free == free_before
+    assert pool.used_blocks() == 4
+    assert (pool.tables[2] == TRASH_BLOCK).all()
+    pool.leak_audit()
+
+
+def test_double_admit_same_slot_raises():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_slot=2, n_slots=4)
+    pool.admit(0, 1, *_row(*range(8)))
+    with pytest.raises(RuntimeError, match="still owning"):
+        pool.admit(0, 1, *_row(*range(8)))
+
+
+# ---------------------------------------------------------- prefix sharing
+
+
+def test_prefix_hit_pins_shared_block_and_skips_its_tokens():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_slot=2, n_slots=4)
+    ids, msk = _row(*range(8))
+    row0, hit0 = pool.admit(0, 1, ids, msk)
+    assert hit0 == 0  # empty registry: no hit
+    pool.register_prefix(0, 1, ids, msk)
+    # same content, width 8, cap (8-1)//4 = 1: block 0 shares, block 1 stays
+    # private even though its digest is registered (full-prompt-hit cap)
+    row1, hit1 = pool.admit(1, 1, ids, msk)
+    assert hit1 == 4
+    assert row1[0] == row0[0] and row1[1] != row0[1]
+    assert pool.ref[row0[0]] == 2  # pinned by both slots
+    assert pool.hits_total == 1 and pool.tokens_saved_total == 4
+    assert pool.shared_blocks(1) == [row0[0]] and pool.prefix_hit_tokens(1) == 4
+    # releasing the ORIGINAL owner keeps the shared block alive for slot 1
+    pool.release(0)
+    assert pool.ref[row0[0]] == 1
+    pool.leak_audit()
+    pool.release(1)
+    # registered block parks warm, the private ones free
+    assert pool.cached_blocks() >= 1
+    pool.leak_audit(expect_idle=True)
+
+
+def test_divergent_tail_stops_sharing_without_copy():
+    # 3 blocks/slot, width 12: blocks 0-1 registrable under the hit cap
+    pool = BlockPool(n_blocks=12, block_size=4, blocks_per_slot=3, n_slots=4)
+    ids, msk = _row(*range(12))
+    row0, _ = pool.admit(0, 1, ids, msk)
+    pool.register_prefix(0, 1, ids, msk)
+    # same block 0, divergent block 1: hit stops at the first mismatch
+    ids2 = ids.copy()
+    ids2[5] += 1
+    row1, hit = pool.admit(1, 1, ids2, msk)
+    assert hit == 4
+    assert row1[0] == row0[0]
+    assert row1[1] != row0[1] and row1[2] != row0[2]  # private from divergence on
+    pool.release(0)
+    pool.release(1)
+    pool.leak_audit(expect_idle=True)
+
+
+def test_no_hit_across_weight_versions():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_slot=2, n_slots=4)
+    ids, msk = _row(*range(8))
+    pool.admit(0, 1, ids, msk)
+    pool.register_prefix(0, 1, ids, msk)
+    pool.release(0)
+    _, hit = pool.admit(1, 2, ids, msk)  # version 2: stale KV must not share
+    assert hit == 0
+
+
+def test_flush_registry_on_version_switch():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_slot=2, n_slots=4)
+    ids, msk = _row(*range(8))
+    pool.admit(0, 1, ids, msk)
+    pool.register_prefix(0, 1, ids, msk)
+    other, om = _row(*range(50, 58))
+    pool.admit(1, 1, other, om)
+    pool.register_prefix(1, 1, other, om)
+    pool.release(0)  # slot 0's registered blocks park warm
+    assert pool.cached_blocks() == 2
+    pool.flush_registry()  # in-flight weight switch mid-decode
+    # warm entry freed outright; slot 1's pinned block only unregistered
+    assert pool.cached_blocks() == 0
+    assert pool.used_blocks() == 2
+    _, hit = pool.admit(2, 1, ids, msk)
+    assert hit == 0  # old-version KV is gone from the registry
+    pool.release(1)
+    pool.release(2)
+    pool.leak_audit(expect_idle=True)
+
+
+# --------------------------------------------------------------- eviction
+
+
+def test_lru_eviction_oldest_first():
+    # blocks 1..3, single-block spans, 6-token rows (block 0 registrable)
+    pool = BlockPool(n_blocks=4, block_size=4, blocks_per_slot=1, n_slots=4)
+    a, am = _row(*range(6))
+    b, bm = _row(*range(10, 16))
+    pool.admit(0, 1, a, am)
+    pool.register_prefix(0, 1, a, am)
+    pool.release(0)  # a's template parks warm (oldest)
+    pool.admit(1, 1, b, bm)
+    pool.register_prefix(1, 1, b, bm)
+    pool.release(1)  # b's template parks warm (youngest)
+    assert pool.cached_blocks() == 2 and len(pool.free) == 1
+    pool.admit(2, 1, *_row(*range(20, 26)))  # last free block, no eviction
+    assert pool.evictions == 0
+    pool.admit(3, 1, *_row(*range(30, 36)))  # dry -> evict the OLDEST only
+    assert pool.evictions == 1 and pool.cached_blocks() == 1
+    pool.release(2)
+    pool.release(3)
+    # a's template was evicted -> miss; b's (younger) survived -> hit
+    _, hit_a = pool.admit(0, 1, a, am)
+    assert hit_a == 0
+    pool.release(0)
+    _, hit_b = pool.admit(1, 1, b, bm)
+    assert hit_b == 4
+    pool.release(1)
+    pool.leak_audit(expect_idle=True)
+
+
+def test_pinned_warm_hit_costs_availability():
+    pool = BlockPool(n_blocks=4, block_size=4, blocks_per_slot=2, n_slots=4)
+    ids, msk = _row(*range(8))
+    pool.admit(0, 1, ids, msk)
+    pool.register_prefix(0, 1, ids, msk)
+    pool.release(0)  # 1 warm template + 2 free: 3 allocatable
+    _, hit = pool.admit(1, 1, ids, msk)  # pins the warm block + 1 private
+    assert hit == 4
+    # the pinned template left the evictable set: one block remains, so a
+    # 2-block span no longer fits (the feasibility check counts fresh pins)
+    assert pool.available() == 1
+    with pytest.raises(PoolExhausted):
+        pool.admit(2, 1, *_row(*range(40, 48)))
+    pool.release(1)
+    pool.leak_audit(expect_idle=True)
+
+
+# -------------------------------------------------------------- leak audit
+
+
+def test_leak_audit_names_violations():
+    pool = BlockPool(n_blocks=5, block_size=4, blocks_per_slot=2, n_slots=2)
+    pool.admit(0, 1, *_row(*range(8)))
+    with pytest.raises(RuntimeError, match="still owned"):
+        pool.leak_audit(expect_idle=True)
+    # a lost block: simulate bookkeeping damage
+    blk = pool._slot_private[0].pop()
+    with pytest.raises(RuntimeError, match=f"block {blk}"):
+        pool.leak_audit()
+
+
+def test_release_detects_negative_refcount():
+    pool = BlockPool(n_blocks=5, block_size=4, blocks_per_slot=2, n_slots=2)
+    pool.admit(0, 1, *_row(*range(8)))
+    stolen = list(pool._slot_private[0])
+    pool.release(0)
+    pool._slot_private[0] = stolen  # replay the release
+    with pytest.raises(RuntimeError, match="negative"):
+        pool.release(0)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match=">= 2 blocks"):
+        BlockPool(n_blocks=1, block_size=4, blocks_per_slot=1, n_slots=1)
+    with pytest.raises(ValueError, match="worst-case span"):
+        BlockPool(n_blocks=3, block_size=4, blocks_per_slot=4, n_slots=1)

@@ -487,6 +487,11 @@ BASELINE_TRUTHY_FIELDS = frozenset(
         "prefetch_depth", "prefill_batch", "engine_steps_per_sync",
         "tau", "cql_scale", "awac_scale", "alpha", "steps_for_target_q_sync",
         "betas", "two_qs", "n_soft_tokens", "initialize_from_vocab",
+        # kv_block_size is a PARAMETER of the paged-KV feature, not its
+        # toggle: it is only read when paged_kv (default False) is on, so
+        # the serial path stays byte-identical with it truthy. 128 is the
+        # TPU lane width the paged decode kernel wants (RUNBOOK §20).
+        "kv_block_size",
     }
 )
 
@@ -673,6 +678,7 @@ TILING_FACTORIES = {
     "decode_block_layout",
     "slot_decode_layout",
     "spec_verify_layout",
+    "paged_decode_layout",
     "flash_block_layout",
     "fused_logprob_block_layout",
     "check_layout",

@@ -148,6 +148,32 @@ class PPOConfig(MethodConfig):
     # live slot advances >= 1 token per dispatch). 0 = auto (4 when
     # spec_decode is armed). Values >= 2 required when armed.
     spec_k: int = 0
+    # paged_kv: paged KV cache + prefix caching inside the rollout engine
+    # (ROADMAP item 3). The fixed per-slot [n_slots, T] cache becomes ONE
+    # shared physical block pool [n_blocks, block_size, h, d] plus per-slot
+    # block tables; prompt prefixes whose block-aligned content already sits
+    # in the pool (same weight version) are SHARED — admission pins the
+    # resident blocks and prefills only the suffix, so identical prompt
+    # templates prefill once per weight version instead of once per slot.
+    # Composes with kv_cache_quant (int8 pool + per-block scales) and
+    # spec_decode (verify windows write through the table; the spec_k-1
+    # scratch tail lives in each slot's last block). Requires rollout_engine
+    # and no soft prompts. Off (default) keeps the engine byte-identical.
+    paged_kv: bool = False
+    # kv_block_size: tokens per physical KV block. The TPU flash decode
+    # kernel needs block_size % 128 == 0 (the bias tile constraint,
+    # ops/tiling.py:paged_decode_layout) unless a slot fits in one block;
+    # off-kernel (CPU tests, interpret) any size >= 1 works. 128 keeps the
+    # kernel path on real workloads.
+    kv_block_size: int = 128
+    # kv_pool_blocks: physical blocks in the shared pool (incl. the reserved
+    # trash block 0). 0 = auto: 1 + engine_slots * ceil(cache_len /
+    # kv_block_size) — full worst-case commitment, never a capacity
+    # regression. Set BELOW auto to serve more slots than the same bytes
+    # could hold fixed-slot (prefix sharing covers the difference); admission
+    # is transactional, so an oversubscribed pool requeues instead of
+    # deadlocking. See RUNBOOK §20 for the sizing math.
+    kv_pool_blocks: int = 0
     # Disaggregated rollout/learner fleet (trlx_tpu/fleet): dedicated
     # rollout and learner JOBS (each its own single-controller JAX world)
     # coupled by a versioned weight broadcast and a bounded-staleness

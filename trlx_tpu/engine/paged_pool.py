@@ -1,0 +1,299 @@
+"""Host-side block pool for the paged KV cache (vLLM-style paging for the
+slot engine, arxiv 2509.19128 / 2606.26997 lean on the same decode-side
+memory economics).
+
+The device holds ONE shared physical pool per layer
+([n_blocks, block_size, n_head, head_dim], models/lm.init_paged_cache); this
+module is the authoritative host mirror that decides which physical block
+every slot's virtual block maps to. All mutation happens on the engine's
+step() thread and every decision is deterministic (free list order, LRU
+order, registry walk), so multi-host replicas that see the same admission
+stream build bit-identical block tables — the engine folds every table row
+into its schedule crc to catch divergence by name.
+
+Three mechanisms, one invariant:
+
+- **Free-list allocation with full worst-case commitment**: a slot is
+  admitted only if its whole virtual span (blocks_per_slot minus the blocks
+  a prefix hit shares) can be allocated UP FRONT. Mid-decode growth can
+  therefore never fail, which is what lets the engine keep its
+  one-compiled-program decode loop with no preemption/swap path.
+- **Prefix caching**: admission hashes the prompt's block-aligned leading
+  blocks (chained over (ids, mask) content — left-padding is content, so
+  only bit-identical columns share) keyed by weight version. A hit pins the
+  registered blocks (refcount++) and the slot prefills only its suffix; a
+  divergent tail simply allocates private blocks from the first
+  non-matching block on (copy-on-write without the copy: prompt blocks are
+  immutable once written, so "diverge" means "stop sharing", never
+  "duplicate then edit"). At harvest, fully-prompt-covered private blocks
+  are registered so the NEXT admission can share them.
+- **LRU eviction**: released registered blocks (refcount 0) stay warm in an
+  LRU so templates survive slot churn; when the free list runs dry the
+  oldest cached block is evicted (unregistered) and reused. Pinned blocks
+  are never evicted.
+
+Block 0 is the reserved TRASH block: free/dead slots' table entries point at
+it, so the decode program's clamped writes for dead rows land somewhere no
+live slot ever reads with nonzero attention weight — a freed physical block
+can be re-issued immediately without waiting for the dead row's writes to
+stop.
+
+``leak_audit`` asserts the partition invariant (trash + free + referenced +
+cached == n_blocks, refcounts consistent with the per-slot ownership lists)
+— the engine runs it at abort()/shutdown so the fleet drills catch a leaked
+block as a named RuntimeError instead of a slow pool-exhaustion hang.
+"""
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+TRASH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Admission asked for more blocks than free + evictable can supply."""
+
+
+def prefix_block_digests(ids, mask, block_size, n_blocks_max, seed=b""):
+    """Chained content digests of the leading full blocks of a prompt row.
+
+    ids/mask are the bucket-width LEFT-PADDED row as submitted — padding
+    columns are part of the hashed content, so two rows share a block iff
+    the (ids, mask) columns are bit-identical, which is exactly the
+    condition under which their written KV is bit-identical (per-token
+    projections at mask-derived positions). Chaining makes block j's digest
+    commit to blocks [0, j], so a registry walk can stop at the first
+    mismatch."""
+    ids = np.ascontiguousarray(np.asarray(ids, dtype=np.int32))
+    mask = np.ascontiguousarray(np.asarray(mask, dtype=np.int32))
+    digests = []
+    h = seed
+    for b in range(n_blocks_max):
+        lo, hi = b * block_size, (b + 1) * block_size
+        if hi > ids.shape[0]:
+            break
+        h = hashlib.sha256(
+            h + ids[lo:hi].tobytes() + mask[lo:hi].tobytes()
+        ).digest()
+        digests.append(h)
+    return digests
+
+
+class BlockPool:
+    """Deterministic host allocator over ``n_blocks`` physical KV blocks."""
+
+    def __init__(self, n_blocks, block_size, blocks_per_slot, n_slots):
+        if n_blocks < 2:
+            raise ValueError(f"pool needs >= 2 blocks (trash + 1), got {n_blocks}")
+        if n_blocks - 1 < blocks_per_slot:
+            raise ValueError(
+                f"pool of {n_blocks} blocks cannot hold even one slot's "
+                f"worst-case span of {blocks_per_slot} blocks"
+            )
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.blocks_per_slot = int(blocks_per_slot)
+        self.n_slots = int(n_slots)
+        # Ascending pop order (pop() from the tail): 1, 2, 3, ... — any
+        # deterministic order works; ascending makes incident dumps legible.
+        self.free = list(range(self.n_blocks - 1, 0, -1))
+        self.ref = np.zeros((self.n_blocks,), dtype=np.int64)
+        # Host mirror of the device block tables (trash-initialized).
+        self.tables = np.zeros((self.n_slots, self.blocks_per_slot), dtype=np.int32)
+        # Per-slot ownership: pinned shared prefix blocks / private blocks.
+        self._slot_shared = [[] for _ in range(self.n_slots)]
+        self._slot_private = [[] for _ in range(self.n_slots)]
+        # Prefix registry: (version, digest) -> block id, plus the reverse
+        # map and the ref==0 warm cache in least-recently-released order.
+        self._registry = {}
+        self._owner_key = {}
+        self._lru = OrderedDict()
+        self.hits_total = 0
+        self.tokens_saved_total = 0
+        self.evictions = 0
+
+    # ----------------------------------------------------------- allocation
+
+    def available(self) -> int:
+        """Blocks an admission could obtain: free + evictable (warm cache)."""
+        return len(self.free) + len(self._lru)
+
+    def used_blocks(self) -> int:
+        """Blocks referenced by at least one live slot."""
+        return int((self.ref > 0).sum())
+
+    def cached_blocks(self) -> int:
+        """Warm (ref==0, registered, evictable) blocks."""
+        return len(self._lru)
+
+    def _take_block(self) -> int:
+        if self.free:
+            return self.free.pop()
+        if self._lru:
+            # Evict the least-recently-released cached prefix block.
+            blk, _ = self._lru.popitem(last=False)
+            key = self._owner_key.pop(blk)
+            del self._registry[key]
+            self.evictions += 1
+            return blk
+        raise PoolExhausted("no free or evictable blocks")
+
+    def lookup_prefix(self, version, ids, mask, max_hit_blocks):
+        """Longest registered chain of leading blocks, capped so at least one
+        prompt token always prefills (the frontier logits must come from a
+        real apply). Pure read — no pins, no counter bumps."""
+        hits = []
+        for d in prefix_block_digests(ids, mask, self.block_size, max_hit_blocks):
+            blk = self._registry.get((version, d))
+            if blk is None:
+                break
+            hits.append(blk)
+        return hits
+
+    def admit(self, slot, version, ids, mask):
+        """Transactionally allocate slot's full worst-case span: pin the
+        registered prefix blocks the prompt hits, take private blocks for
+        the rest of the span, and build the table row. Raises PoolExhausted
+        with NOTHING mutated if the span cannot be covered; the caller
+        re-queues the prompt and waits for a harvest."""
+        if self._slot_shared[slot] or self._slot_private[slot]:
+            raise RuntimeError(f"slot {slot} admitted while still owning blocks")
+        width = int(np.asarray(ids).shape[0])
+        # Cap: hit blocks must lie strictly inside the prompt — a full-prompt
+        # hit would leave a zero-token suffix and no frontier logits.
+        max_hit = min(self.blocks_per_slot, (width - 1) // self.block_size)
+        hits = self.lookup_prefix(version, ids, mask, max_hit)
+        # Feasibility BEFORE mutation: pinning a warm (LRU) hit removes it
+        # from the evictable set, so it costs one unit of availability just
+        # like a private allocation does.
+        fresh_pins = len({b for b in hits if b in self._lru})
+        need_private = self.blocks_per_slot - len(hits)
+        if self.available() - fresh_pins < need_private:
+            raise PoolExhausted(
+                f"slot {slot} needs {need_private} private blocks "
+                f"(+{fresh_pins} warm pins) but only {self.available()} are "
+                "free or evictable"
+            )
+        for b in hits:
+            if self.ref[b] == 0:
+                self._lru.pop(b)
+            self.ref[b] += 1
+        private = [self._take_block() for _ in range(need_private)]
+        for b in private:
+            self.ref[b] += 1
+        self._slot_shared[slot] = list(hits)
+        self._slot_private[slot] = private
+        row = np.asarray(hits + private, dtype=np.int32)
+        self.tables[slot] = row
+        H = len(hits) * self.block_size
+        if hits:
+            self.hits_total += 1
+            self.tokens_saved_total += H
+        return row.copy(), H
+
+    def register_prefix(self, slot, version, ids, mask):
+        """After the slot's prefill dispatch: make its freshly written
+        full-prompt private blocks shareable. Only blocks wholly inside the
+        prompt register (a block straddling the prompt/response boundary
+        receives decode writes and is never immutable); digests already in
+        the registry keep their original owner — this slot's duplicate block
+        stays private and frees at harvest."""
+        width = int(np.asarray(ids).shape[0])
+        digests = prefix_block_digests(ids, mask, self.block_size, width // self.block_size)
+        for b, d in enumerate(digests):
+            key = (version, d)
+            if key in self._registry:
+                continue
+            blk = int(self.tables[slot][b])
+            if blk in self._owner_key:  # already registered under another key
+                continue
+            self._registry[key] = blk
+            self._owner_key[blk] = key
+
+    def release(self, slot):
+        """Harvest/abort: drop the slot's references. Registered blocks that
+        reach ref 0 park in the warm cache; unregistered ones go straight
+        back to the free list. The caller must also repoint the DEVICE table
+        row at the trash block before the freed blocks can be re-issued."""
+        for b in self._slot_shared[slot] + self._slot_private[slot]:
+            self.ref[b] -= 1
+            if self.ref[b] < 0:
+                raise RuntimeError(f"block {b} refcount went negative (slot {slot})")
+            if self.ref[b] == 0:
+                if b in self._owner_key:
+                    self._lru[b] = None  # most-recently-released at the tail
+                else:
+                    self.free.append(b)
+        self._slot_shared[slot] = []
+        self._slot_private[slot] = []
+        self.tables[slot] = TRASH_BLOCK
+
+    def shared_blocks(self, slot):
+        return list(self._slot_shared[slot])
+
+    def prefix_hit_tokens(self, slot) -> int:
+        return len(self._slot_shared[slot]) * self.block_size
+
+    def flush_registry(self):
+        """Weight-version adoption: cached KV from the old weights must never
+        be shared into new-version slots. Warm (ref==0) entries free
+        immediately; pinned entries (live slots still decoding over them)
+        just unregister — their blocks free normally at harvest."""
+        for blk in list(self._lru.keys()):
+            key = self._owner_key.pop(blk)
+            del self._registry[key]
+            self.free.append(blk)
+        self._lru.clear()
+        for blk in list(self._owner_key.keys()):
+            key = self._owner_key.pop(blk)
+            del self._registry[key]
+
+    # ------------------------------------------------------------ invariants
+
+    def leak_audit(self, expect_idle=False):
+        """Raise RuntimeError on any partition/refcount violation. With
+        ``expect_idle`` (abort/shutdown, no slot may own anything) every
+        non-free block must be a warm registered cache entry."""
+        owned = {}
+        for s in range(self.n_slots):
+            for b in self._slot_shared[s] + self._slot_private[s]:
+                owned[b] = owned.get(b, 0) + 1
+        problems = []
+        if TRASH_BLOCK in self.free or TRASH_BLOCK in owned or TRASH_BLOCK in self._lru:
+            problems.append("trash block leaked into free/owned/cache")
+        free_set = set(self.free)
+        if len(free_set) != len(self.free):
+            problems.append("duplicate blocks on the free list")
+        for b in range(1, self.n_blocks):
+            states = (
+                (b in free_set) + (b in self._lru) + (self.ref[b] > 0)
+            )
+            if states != 1:
+                problems.append(
+                    f"block {b} in {states} states (free={b in free_set}, "
+                    f"cached={b in self._lru}, ref={int(self.ref[b])})"
+                )
+            if self.ref[b] != owned.get(b, 0):
+                problems.append(
+                    f"block {b} ref {int(self.ref[b])} != slot ownership "
+                    f"{owned.get(b, 0)}"
+                )
+        for blk in self._lru:
+            if blk not in self._owner_key:
+                problems.append(f"cached block {blk} is not registered")
+        for key, blk in self._registry.items():
+            if self._owner_key.get(blk) != key:
+                problems.append(f"registry/reverse-map mismatch on block {blk}")
+        if expect_idle and owned:
+            problems.append(f"idle pool still owned: {sorted(owned)}")
+        if expect_idle:
+            accounted = 1 + len(free_set) + len(self._lru)
+            if accounted != self.n_blocks:
+                problems.append(
+                    f"idle pool leaks blocks: trash+free+cached={accounted} "
+                    f"!= n_blocks={self.n_blocks}"
+                )
+        if problems:
+            raise RuntimeError("KV pool leak audit failed: " + "; ".join(problems))

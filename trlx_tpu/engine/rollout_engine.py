@@ -53,7 +53,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trlx_tpu.models.lm import init_cache
+from trlx_tpu.engine.paged_pool import BlockPool, PoolExhausted
+from trlx_tpu.models.lm import init_cache, init_paged_cache
 from trlx_tpu.observability import graftscope
 from trlx_tpu.observability import numerics as obs_numerics
 from trlx_tpu.observability import spans as obs_spans
@@ -122,6 +123,9 @@ class RolloutEngine:
         spec_decode: str = "",
         spec_k: int = 0,
         drafter=None,
+        paged_kv: bool = False,
+        kv_block_size: int = 128,
+        kv_pool_blocks: int = 0,
         dispatch_lock=None,
         monitor=None,
         rng=None,
@@ -160,6 +164,37 @@ class RolloutEngine:
             # onto valid (mask-1) entries. Scratch positions never get a
             # mask bit, so they are never attended.
             self.cache_len += self.spec_k - 1
+        self.paged = bool(paged_kv)
+        if self.paged:
+            # Paged KV (ROADMAP item 3): the slot cache becomes ONE shared
+            # physical block pool plus per-slot block tables. Each slot keeps
+            # a VIRTUAL cache of kv_len = ceil(cache_len / block) * block
+            # columns — every legacy offset/mask/bias contract unchanged —
+            # and the pool size decouples memory from n_slots x max-width.
+            if self.n_soft:
+                raise ValueError(
+                    "paged_kv does not compose with soft prompts yet: the "
+                    "learned prefix would alias every slot's block 0 content "
+                    "(disable method.paged_kv or n_soft_tokens)"
+                )
+            self.block_size = int(kv_block_size)
+            if self.block_size < 1:
+                raise ValueError(f"kv_block_size must be >= 1, got {kv_block_size}")
+            self.blocks_per_slot = -(-self.cache_len // self.block_size)
+            self.kv_len = self.blocks_per_slot * self.block_size
+            # Default pool: full commitment for every slot (+ trash block 0)
+            # — same worst-case capacity as the fixed layout, so default-on
+            # sizing can never be a regression; savings come from setting
+            # kv_pool_blocks below it once prefix sharing is in play.
+            self.n_blocks = int(kv_pool_blocks) or (
+                1 + self.n_slots * self.blocks_per_slot
+            )
+            self.pool = BlockPool(
+                self.n_blocks, self.block_size, self.blocks_per_slot, self.n_slots
+            )
+        else:
+            self.kv_len = self.cache_len
+            self.pool = None
         self.prefill_batch = max(1, int(prefill_batch))
         self.steps_per_sync = max(1, int(steps_per_sync))
         self._lock = dispatch_lock
@@ -206,7 +241,10 @@ class RolloutEngine:
         # the life of the engine — that is the one-compiled-program contract.
         self._traces = {"decode": 0, "prefill": 0, "verify": 0}
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1,))
+        self._prefill = jax.jit(
+            self._prefill_paged_fn if self.paged else self._prefill_fn,
+            donate_argnums=(1,),
+        )
         # Identity unless TRLX_TPU_SANITIZE=dispatch armed the lock we were
         # handed — then every engine dispatch asserts lock ownership.
         self._decode = sanitize.wrap_dispatch("engine/decode", self._decode, dispatch_lock)
@@ -375,6 +413,14 @@ class RolloutEngine:
                 )
                 meta.setdefault("switches", []).append((pos, version))
             self._weight_switches += 1
+        if self.paged and version != self.weight_version:
+            # Prefix blocks hold KV computed under the OUTGOING weights:
+            # sharing them into a new-version slot would mix versions inside
+            # one episode's prompt. Warm cache entries free now; pinned ones
+            # (live slots mid-decode over them — the in-flight contract lets
+            # those finish on recorded version spans) just unregister and
+            # free at harvest. Shared templates re-prefill ONCE per version.
+            self.pool.flush_registry()
         self._variables = variables
         self.weight_version = version
 
@@ -474,7 +520,37 @@ class RolloutEngine:
                     )
                 )
                 self._free.append(i)
+                if self.paged:
+                    # Release the slot's span: pinned shared blocks unref,
+                    # registered prompt blocks park in the warm cache,
+                    # everything else returns to the free list.
+                    self.pool.release(i)
+            if self.paged:
+                # Repoint the harvested rows' DEVICE tables at the trash
+                # block BEFORE any freed block can be re-issued: the dead
+                # rows keep issuing clamped writes inside the compiled
+                # decode program, and those must land on the trash block,
+                # not on a block the next admission now owns.
+                idx = self._globalize(np.asarray(done, dtype=np.int32))
+                self._state = dict(
+                    self._state,
+                    block_tables=self._state["block_tables"].at[idx].set(0),
+                )
             self._completed += len(done)
+        if self.paged:
+            scope = graftscope.scope()
+            if scope is not None:
+                # Pool occupancy sample per sync boundary — the slot-timeline
+                # pool row (host bookkeeping only, no device read).
+                scope.record_pool(
+                    self.pool.used_blocks(),
+                    self.pool.cached_blocks(),
+                    len(self.pool.free),
+                    self.n_blocks,
+                    self._pool_frag(),
+                    self.pool.hits_total,
+                    self.pool.tokens_saved_total,
+                )
         return episodes
 
     def _step_decode(self, n_live):
@@ -672,6 +748,8 @@ class RolloutEngine:
         free (or the whole queue fits in fewer) so each prefill dispatch
         carries a full same-width group; with no live slots it admits
         unconditionally — an empty pool must never wait on itself."""
+        if self.paged:
+            return self._admit_paged()
         admitted = 0
         while self._free and len(self.queue):
             want = min(self.prefill_batch, len(self.queue))
@@ -747,6 +825,131 @@ class RolloutEngine:
             admitted += int(ids.shape[0])
         return admitted
 
+    def _admit_paged(self) -> int:
+        """Paged admission: same batching policy as ``_admit``, plus the
+        block-pool gate and prefix caching.
+
+        Each popped row is admitted transactionally against the pool
+        (worst-case span committed up front: prefix-hit blocks pinned,
+        private blocks allocated). The first row the pool cannot serve stops
+        the group — it and the rest re-queue (back of their width bucket;
+        deterministic on every host) and wait for a harvest to free blocks.
+        Admitted rows then prefill in (width, hit-length) subgroups — one
+        compiled suffix-prefill program per (rows, suffix width) shape — and
+        register their freshly written full-prompt blocks for the NEXT
+        admission to share."""
+        admitted = 0
+        while self._free and len(self.queue):
+            want = min(self.prefill_batch, len(self.queue))
+            if len(self._free) < want and self.live_slots > 0:
+                break
+            group = self.queue.pop_group(min(len(self._free), self.prefill_batch))
+            if group is None:
+                break
+            width, ids, msk = group
+            n = int(ids.shape[0])
+            rows = []  # (slot, row index, table row, hit tokens)
+            for r in range(n):
+                slot = self._free[-1]
+                try:
+                    tbl_row, hit = self.pool.admit(
+                        slot, self.weight_version, ids[r], msk[r]
+                    )
+                except PoolExhausted:
+                    break
+                self._free.pop()
+                rows.append((slot, r, tbl_row, hit))
+            if len(rows) < n:
+                # Pool-bound, not slot-bound: requeue the tail and stop
+                # admitting until a harvest releases blocks. A single-row
+                # admission against an idle pool always succeeds (init
+                # validates n_blocks - 1 >= blocks_per_slot), so this can
+                # only happen with live slots to wait on.
+                rest = [r for r in range(len(rows), n)]
+                self.queue.push_rows(ids[rest], msk[rest])
+            if not rows:
+                break
+            slots_admitted = [s for s, _, _, _ in rows]
+            self._roll_schedule("admit", int(width), len(rows), *slots_admitted)
+            for slot, _, tbl_row, hit in rows:
+                # The table row and hit length are pool decisions — fold them
+                # into the schedule crc so a divergent allocator on one host
+                # is caught by name, not by silently different attention.
+                self._roll_schedule("pool", slot, hit, *tbl_row)
+            by_hit = {}
+            for slot, r, tbl_row, hit in rows:
+                by_hit.setdefault(hit, []).append((slot, r, tbl_row))
+            scope = graftscope.scope()
+            for hit, sub in by_hit.items():
+                slots = np.asarray([s for s, _, _ in sub], dtype=np.int32)
+                rr = [r for _, r, _ in sub]
+                tables = np.stack([t for _, _, t in sub]).astype(np.int32)
+                sub_ids = ids[rr]
+                sub_msk = msk[rr]
+                t0 = time.time()
+                with trace_span(
+                    "engine/prefill", n=len(sub), width=int(width), hit=int(hit)
+                ):
+                    with self._dispatch():
+                        prev_state = self._state
+                        self._state = self._prefill(
+                            self._variables,
+                            self._state,
+                            self._globalize(sub_ids[:, hit:]),
+                            self._globalize(sub_msk),
+                            self._globalize(slots),
+                            self._globalize(tables),
+                        )
+                    # _prefill donates the slot state (donate_argnums=(1,)).
+                    sanitize.mark_donated(
+                        prev_state, "engine._prefill(state) [admit_paged]"
+                    )
+                    del prev_state
+                self._prefill_wall += time.time() - t0
+                for row, slot in enumerate(slots):
+                    j = int(slot)
+                    r = rr[row]
+                    # The prefill dispatch above wrote this row's prompt
+                    # blocks (device program order makes them visible to any
+                    # later dispatch) — register the full-prompt ones so the
+                    # next admission with the same (version, content) shares
+                    # instead of re-prefilling.
+                    self.pool.register_prefix(
+                        j, self.weight_version, ids[r], msk[r]
+                    )
+                    self._slot_meta[j] = {
+                        "prompt_ids": ids[r],
+                        "prompt_mask": msk[r],
+                        "version": self.weight_version,
+                        "prefix_hit": int(hit),
+                    }
+                    if self.spec_decode:
+                        self._spec_last_tok[j] = int(ids[r, -1])
+                        self.drafter.reset_slot(j, ids[r][msk[r] > 0].tolist())
+                    if scope is not None:
+                        self._slot_meta[j]["admit_t"] = t0
+                        self._slot_meta[j]["width"] = int(width)
+                        freed = self._slot_free_t[j]
+                        wait_s = (t0 - freed) if freed is not None else None
+                        scope.record_refill(j, int(width), wait_s)
+                        obs_spans.instant(
+                            "engine/slot/admit",
+                            slot=j,
+                            width=int(width),
+                            hit=int(hit),
+                            **(
+                                {"wait_ms": round(wait_s * 1e3, 3)}
+                                if wait_s is not None
+                                else {}
+                            ),
+                        )
+                self._prefill_calls += 1
+            self._refills += len(rows)
+            admitted += len(rows)
+            if len(rows) < n:
+                break
+        return admitted
+
     def stats(self, reset: bool = True) -> dict:
         """Window gauges: slot occupancy (live-slot decode steps over total
         slot-steps paid), refill counters, and the engine-side decode rate."""
@@ -775,22 +978,74 @@ class RolloutEngine:
             out["engine/spec_accept_rate"] = self._spec_accepted / max(
                 1, self._spec_proposed
             )
+        if self.paged:
+            # Pool gauges (cumulative counters are lifetime totals — the
+            # bench/triage consumers diff them, matching the *_total names).
+            out["engine/pool_blocks"] = self.n_blocks
+            out["engine/pool_used_blocks"] = self.pool.used_blocks()
+            out["engine/pool_cached_blocks"] = self.pool.cached_blocks()
+            out["engine/pool_free_blocks"] = len(self.pool.free)
+            out["engine/pool_frag_frac"] = self._pool_frag()
+            out["engine/pool_evictions_total"] = self.pool.evictions
+            out["engine/prefix_hits_total"] = self.pool.hits_total
+            out["engine/prefill_tokens_saved_total"] = self.pool.tokens_saved_total
         if reset:
             self._reset_counters()
         return out
 
+    def _pool_frag(self) -> float:
+        """Internal fragmentation of the referenced pool span: 1 − (tokens
+        actually resident) / (referenced blocks × block_size). Worst-case
+        commitment makes this the price of never preempting — the gauge is
+        what says whether a smaller kv_pool_blocks would still fit."""
+        used = self.pool.used_blocks()
+        if used == 0:
+            return 0.0
+        toks = 0
+        shared = set()
+        for i in range(self.n_slots):
+            meta = self._slot_meta[i]
+            if meta is None:
+                continue
+            width = int(meta.get("width", len(meta["prompt_ids"])))
+            n_gen = int(self._n_gen_host[i]) if self._n_gen_host is not None else 0
+            # The slot's private resident tokens (its shared prefix tokens
+            # are counted once, below, over the distinct shared blocks).
+            toks += min(width + n_gen, self.kv_len) - int(meta.get("prefix_hit", 0))
+            shared.update(self.pool.shared_blocks(i))
+        toks += len(shared) * self.block_size
+        return max(0.0, 1.0 - toks / float(used * self.block_size))
+
     def abort(self):
         """Drop queued prompts and in-flight slots (phase abort on a stop
         request). Device buffers are kept for the next phase; all slots are
-        deactivated so a subsequent decode has no live rows."""
+        deactivated so a subsequent decode has no live rows. With paged_kv,
+        every in-flight slot's pinned/private blocks are released (the warm
+        prefix cache survives — an abort is not a version change) and the
+        pool's leak audit runs: a block the bookkeeping lost raises HERE,
+        named, instead of surfacing later as slow pool exhaustion."""
         self.queue.clear()
+        if self.paged:
+            for i in range(self.n_slots):
+                if self._slot_meta[i] is not None:
+                    self.pool.release(i)
+            self.pool.leak_audit(expect_idle=True)
         self._slot_meta = [None] * self.n_slots
         self._free = list(range(self.n_slots))
         self._slot_free_t = [None] * self.n_slots
         if self._state is not None:
+            extra = {}
+            if self.paged:
+                # Dead rows park on the trash block, same as at harvest.
+                extra["block_tables"] = self._globalize(
+                    jnp.zeros(
+                        (self.n_slots, self.blocks_per_slot), dtype=jnp.int32
+                    )
+                )
             self._state = dict(
                 self._state,
                 active=self._globalize(jnp.zeros((self.n_slots,), dtype=bool)),
+                **extra,
             )
 
     def shutdown(self):
@@ -814,8 +1069,15 @@ class RolloutEngine:
         if self._state is not None:
             return
         cfg = self.model.cfg
-        S, T, R = self.n_slots, self.cache_len, int(self.gcfg.max_new_tokens)
-        cache = self._pin_cache(init_cache(cfg, S, T))
+        S, T, R = self.n_slots, self.kv_len, int(self.gcfg.max_new_tokens)
+        if self.paged:
+            # One shared physical pool; the per-slot layout pin does not
+            # apply (there is no slot axis to shard) — pool placement is
+            # left to XLA, and _globalize replicates it in multi-process
+            # runs exactly like the fixed cache.
+            cache = init_paged_cache(cfg, self.n_blocks, self.block_size)
+        else:
+            cache = self._pin_cache(init_cache(cfg, S, T))
         state = {
             "cache": cache,
             "cache_mask": jnp.zeros((S, T), dtype=jnp.int32),
@@ -829,6 +1091,12 @@ class RolloutEngine:
             "last_hidden": jnp.zeros((S, cfg.d_model), dtype=cfg.compute_dtype),
             "rng": self._rng,
         }
+        if self.paged:
+            # Trash-initialized tables: every slot's virtual blocks point at
+            # the reserved block 0 until admission assigns a real span.
+            state["block_tables"] = jnp.zeros(
+                (S, self.blocks_per_slot), dtype=jnp.int32
+            )
         if self.spec_decode:
             # Deferred rejection-sampling residual: the draft token the LAST
             # verify window rejected at its break position (-1 = none). The
@@ -984,6 +1252,72 @@ class RolloutEngine:
             s["spec_resid"] = state["spec_resid"].at[slot_ids].set(-1)
         return s
 
+    def _prefill_paged_fn(self, variables, state, suffix_ids, prompt_mask, slot_ids, tables):
+        """Paged prefill of a same-(width, hit) prompt group into its slots.
+
+        ``suffix_ids`` is the prompt MINUS the prefix-cache hit: the first H
+        virtual positions of each row are already resident in shared pool
+        blocks (pinned by the allocator before dispatch), so only the suffix
+        runs through the model. Unlike ``_prefill_fn`` there is no mini
+        cache + scatter: KV writes go straight through the slot's block
+        table into the shared pool (the model's paged cache_write), which is
+        exactly what makes a later admit able to alias this slot's prefix
+        blocks without a copy. The vector ``cache_index`` (= H per row)
+        routes the suffix to virtual positions [H, W); positions derive from
+        the cumsum of the full-row mask, so suffix tokens see the same
+        rotary/ALiBi phases as a full prefill — prefix-cached KV is bitwise
+        identical to full-prefill KV because per-token projections don't mix
+        across positions. Compiled once per (group size, width, hit).
+        ``state`` is donated."""
+        self._traces["prefill"] += 1  # traced-body bump: novel shapes only
+        j, Ws = suffix_ids.shape
+        W = prompt_mask.shape[1]
+        H = W - Ws  # static hit length: part of the trace shape key
+        T = self.kv_len
+        R = int(self.gcfg.max_new_tokens)
+        pm = prompt_mask.astype(jnp.int32)
+        row_mask = jnp.zeros((j, T), dtype=state["cache_mask"].dtype).at[:, :W].set(pm)
+        out = self.model.apply(
+            variables,
+            input_ids=suffix_ids,
+            attention_mask=pm[:, H:],
+            cache=state["cache"],
+            cache_index=jnp.full((j,), H, dtype=jnp.int32),
+            cache_mask=row_mask,
+            block_tables=tables,
+            logits_start=Ws - 1,
+            prepend_soft=False,
+        )
+        s = dict(state)
+        s["cache"] = out["cache"]
+        s["cache_mask"] = state["cache_mask"].at[slot_ids].set(row_mask)
+        s["block_tables"] = state["block_tables"].at[slot_ids].set(tables)
+        s["write_pos"] = state["write_pos"].at[slot_ids].set(W)
+        s["n_gen"] = state["n_gen"].at[slot_ids].set(0)
+        s["active"] = state["active"].at[slot_ids].set(True)
+        s["finished"] = state["finished"].at[slot_ids].set(False)
+        s["tokens"] = (
+            state["tokens"]
+            .at[slot_ids]
+            .set(jnp.full((j, R), self.gcfg.pad_token_id, dtype=state["tokens"].dtype))
+        )
+        s["last_logits"] = (
+            state["last_logits"].at[slot_ids].set(out["logits"][:, -1].astype(jnp.float32))
+        )
+        s["last_hidden"] = (
+            state["last_hidden"]
+            .at[slot_ids]
+            .set(out["hidden"][:, -1].astype(state["last_hidden"].dtype))
+        )
+        # Rows are left-padded, so the suffix's last column IS the prompt's
+        # real last token (H < W is guaranteed by the allocator's hit cap).
+        s["last_token"] = (
+            state["last_token"].at[slot_ids].set(suffix_ids[:, -1].astype(jnp.int32))
+        )
+        if "spec_resid" in state:  # static: spec-armed engines only
+            s["spec_resid"] = state["spec_resid"].at[slot_ids].set(-1)
+        return s
+
     def _decode_fn(self, variables, state):
         """``steps_per_sync`` decode steps for ALL slots in one program.
 
@@ -996,7 +1330,7 @@ class RolloutEngine:
         numerator). ``state`` is donated."""
         self._traces["decode"] += 1  # traced-body bump: must stay at 1
         gcfg = self.gcfg
-        S, T = self.n_slots, self.cache_len
+        S, T = self.n_slots, self.kv_len  # T: virtual cache width (== cache_len unpaged)
         R = int(gcfg.max_new_tokens)
         pad = jnp.asarray(gcfg.pad_token_id, dtype=jnp.int32)
 
@@ -1055,6 +1389,11 @@ class RolloutEngine:
                 cache_index=c_ix,  # [S] vector: per-slot write offsets
                 cache_mask=cache_mask,
                 prepend_soft=False,
+                # Paged: the block tables ride the scan carry unchanged —
+                # table edits happen host-side at admit/harvest boundaries
+                # only. The kwarg is omitted entirely when off so the
+                # non-paged jaxpr stays byte-identical.
+                **({"block_tables": s["block_tables"]} if self.paged else {}),
             )
             live_i = live.astype(jnp.int32)
             new_s = {
@@ -1070,6 +1409,8 @@ class RolloutEngine:
                 "last_hidden": out["hidden"][:, 0].astype(s["last_hidden"].dtype),
                 "rng": rng,
             }
+            if self.paged:
+                new_s["block_tables"] = s["block_tables"]
             return (new_s, live_steps + live_i.sum()), None
 
         (state, live_steps), _ = jax.lax.scan(
@@ -1110,7 +1451,7 @@ class RolloutEngine:
         returns (new_state, accepted [S] int32, window [S, K] int32)."""
         self._traces["verify"] += 1  # traced-body bump: must stay at 1
         gcfg = self.gcfg
-        S, T, K = self.n_slots, self.cache_len, self.spec_k
+        S, T, K = self.n_slots, self.kv_len, self.spec_k
         R = int(gcfg.max_new_tokens)
         pad = jnp.asarray(gcfg.pad_token_id, dtype=jnp.int32)
         live = state["active"] & ~state["finished"]
@@ -1173,6 +1514,11 @@ class RolloutEngine:
             cache_index=c_ix,  # [S] vector: per-slot ragged frontiers
             cache_mask=mask_apply,
             prepend_soft=False,
+            # Paged: verify windows write through the block table like any
+            # other cache write; the scratch tail lives in the slot's LAST
+            # block (kv_len rounds cache_len up, never down), so wp + K <= T
+            # still holds for live rows.
+            **({"block_tables": state["block_tables"]} if self.paged else {}),
         )
         L = out["logits"].astype(jnp.float32)  # [S, K, V]
 

@@ -70,6 +70,7 @@ class LMWithValueHead(nn.Module):
         cache=None,
         cache_index=None,
         cache_mask=None,
+        block_tables=None,
         collect_branch_hidden: bool = False,
         prepend_soft: bool = True,
         logits_start: int = 0,
@@ -86,6 +87,7 @@ class LMWithValueHead(nn.Module):
             cache=cache,
             cache_index=cache_index,
             cache_mask=cache_mask,
+            block_tables=block_tables,
             collect_hidden_at=self.branch_layer if (collect_branch_hidden and self.branch_layer >= 0) else None,
             prepend_soft=prepend_soft,
             logits_start=logits_start,

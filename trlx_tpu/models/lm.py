@@ -350,7 +350,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
-                 flash_mask=None, window=0, use_ring=False):
+                 flash_mask=None, window=0, use_ring=False, block_tables=None):
         cfg = self.cfg
         dtype = cfg.compute_dtype
         b, q_len, _ = x.shape
@@ -386,6 +386,8 @@ class Attention(nn.Module):
             from trlx_tpu.ops.decode_attention import (
                 decode_attn_eligible,
                 decode_attn_supported,
+                paged_decode_eligible,
+                paged_decode_supported,
             )
 
             single_step = q_len == 1 and attn_bias is not None
@@ -401,25 +403,75 @@ class Attention(nn.Module):
             # the buffer end get their start clamped by dynamic_update_slice —
             # callers must size the cache with a k-1 scratch tail so live rows
             # never clamp (see RolloutEngine.cache_len).
+            paged = block_tables is not None
+            if paged:
+                # Paged KV: the per-layer cache operand is ONE shared block
+                # pool [n_blocks, block_size, h, d] and each row addresses it
+                # through its own block table [b, blocks_per_slot]. The row's
+                # VIRTUAL cache keeps every legacy [T] contract — write
+                # offsets, cache_mask, bias, and positions are computed over
+                # t_virt = blocks_per_slot * block_size exactly as over the
+                # fixed buffer — only the physical placement is indirect, so
+                # the write is one advanced-index scatter at (physical block,
+                # in-block offset) and the einsum read gathers the virtual
+                # view back. q_len covers decode (1), spec verify windows
+                # (spec_k), and suffix prefill (W - hit) uniformly.
+                n_blocks_p = int(cache[0].shape[0])
+                blk = int(cache[0].shape[1])
+                bps = int(block_tables.shape[1])
+                t_virt = bps * blk
+                tbl = block_tables.astype(jnp.int32)
+                base = (
+                    cache_index.astype(jnp.int32)[:, None]
+                    if vector_index
+                    else jnp.full((b, 1), cache_index, dtype=jnp.int32)
+                )
+                voff = base + jnp.arange(q_len, dtype=jnp.int32)[None, :]
+                # Live rows never run past t_virt (the engine sizes the slot
+                # table to cover the spec scratch tail); dead rows' clamped
+                # writes collapse onto masked columns of their own table —
+                # the engine parks freed rows on the reserved trash block.
+                voff = jnp.minimum(voff, t_virt - 1)
+                phys = jnp.take_along_axis(tbl, voff // blk, axis=1)
+                off = voff % blk
 
-            def cache_write(buf, upd):
-                # Scalar offset: one dynamic_update_slice covers the batch.
-                # Vector offset [b] (slot decode): every row writes at its own
-                # slot length — a vmap'd per-row update (lowers to scatter).
-                upd = upd.astype(buf.dtype)
-                if vector_index:
-                    zeros = (0,) * (buf.ndim - 2)
-                    return jax.vmap(
-                        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i,) + zeros)
-                    )(buf, upd, cache_index)
-                start = (0, cache_index) + (0,) * (buf.ndim - 2)
-                return jax.lax.dynamic_update_slice(buf, upd, start)
+                def cache_write(pool, upd):
+                    return pool.at[phys, off].set(upd.astype(pool.dtype))
+
+                def gather_virt(pool):
+                    # Virtual-cache view for the einsum path: [b, t_virt, ...].
+                    return pool[tbl].reshape((b, t_virt) + pool.shape[2:])
+
+            else:
+
+                def cache_write(buf, upd):
+                    # Scalar offset: one dynamic_update_slice covers the batch.
+                    # Vector offset [b] (slot decode): every row writes at its own
+                    # slot length — a vmap'd per-row update (lowers to scatter).
+                    upd = upd.astype(buf.dtype)
+                    if vector_index:
+                        zeros = (0,) * (buf.ndim - 2)
+                        return jax.vmap(
+                            lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i,) + zeros)
+                        )(buf, upd, cache_index)
+                    start = (0, cache_index) + (0,) * (buf.ndim - 2)
+                    return jax.lax.dynamic_update_slice(buf, upd, start)
+
+                def gather_virt(buf):
+                    # Legacy per-slot buffers ARE the virtual cache.
+                    return buf
 
             def kernel_ok(quant):
                 # Two gates, both static at trace time: the cheap eligibility
                 # rule, then the one-time cached lowering probe — a shape the
                 # Mosaic lowering rejects warns and takes the einsum path
                 # instead of crashing the compiled rollout program mid-run.
+                if paged:
+                    return paged_decode_eligible(
+                        cfg.n_head, hd, blk, bps, quant
+                    ) and paged_decode_supported(
+                        b, n_blocks_p, blk, bps, cfg.n_head, hd, quant, dtype
+                    )
                 return decode_attn_eligible(
                     cfg.n_head, hd, int(cache[0].shape[1]), quant
                 ) and decode_attn_supported(
@@ -447,9 +499,10 @@ class Attention(nn.Module):
                         # is exactly the int8 bytes.
                         decode_kernel_kv = (k_cache, v_cache, ks_cache, vs_cache)
                     else:
-                        # Dequantize on read for the einsum path.
-                        k = k_cache.astype(dtype) * ks_cache[..., None].astype(dtype)
-                        v = v_cache.astype(dtype) * vs_cache[..., None].astype(dtype)
+                        # Dequantize on read for the einsum path (paged:
+                        # gather the virtual view first).
+                        k = gather_virt(k_cache).astype(dtype) * gather_virt(ks_cache)[..., None].astype(dtype)
+                        v = gather_virt(v_cache).astype(dtype) * gather_virt(vs_cache)[..., None].astype(dtype)
             else:
                 k_cache, v_cache = cache
                 k_cache = cache_write(k_cache, k)
@@ -464,7 +517,7 @@ class Attention(nn.Module):
                     if single_step and kernel_ok(False):
                         decode_kernel_kv = (k_cache, v_cache, None, None)
                     else:
-                        k, v = k_cache, v_cache
+                        k, v = gather_virt(k_cache), gather_virt(v_cache)
 
         scale = 1.0 / np.sqrt(hd) if cfg.scale_attn else 1.0
         if flash_mask is not None:
@@ -483,15 +536,25 @@ class Attention(nn.Module):
                     block_q=blk, block_k=blk,
                 ).astype(dtype)
         elif decode_kernel_kv is not None:
-            from trlx_tpu.ops.decode_attention import decode_attention
+            from trlx_tpu.ops.decode_attention import (
+                decode_attention,
+                paged_decode_attention,
+            )
 
             kc, vc, ksc, vsc = decode_kernel_kv
             # attn_bias is [b, 1, 1, kv] on a single-token step; the kernel
             # takes the one bias row (causality + validity + local window
             # are all already encoded in it).
-            out = decode_attention(
-                q[:, 0], kc, vc, ksc, vsc, attn_bias[:, 0, 0, :], scale=scale
-            ).astype(dtype)
+            if block_tables is not None:
+                out = paged_decode_attention(
+                    q[:, 0], kc, vc, ksc, vsc,
+                    block_tables.astype(jnp.int32), attn_bias[:, 0, 0, :],
+                    scale=scale,
+                ).astype(dtype)
+            else:
+                out = decode_attention(
+                    q[:, 0], kc, vc, ksc, vsc, attn_bias[:, 0, 0, :], scale=scale
+                ).astype(dtype)
         else:
             # [b, n_head, q, kv] scores in fp32 for a stable softmax.
             scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
@@ -529,17 +592,17 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, positions, cache=None, cache_index=None,
-                 flash_mask=None, window=0, use_ring=False):
+                 flash_mask=None, window=0, use_ring=False, block_tables=None):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(epsilon=cfg.ln_eps, dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype, name=name)
         attn = Attention(cfg, name="attn")
         if cfg.parallel_residual:
             h = ln("ln_1")(x)
-            attn_out, new_cache = attn(h, attn_bias, positions, cache, cache_index, flash_mask, window, use_ring)
+            attn_out, new_cache = attn(h, attn_bias, positions, cache, cache_index, flash_mask, window, use_ring, block_tables)
             mlp_in = ln("ln_2")(x) if cfg.use_parallel_ln else h
             x = x + attn_out + MLP(cfg, name="mlp")(mlp_in)
         else:
-            attn_out, new_cache = attn(ln("ln_1")(x), attn_bias, positions, cache, cache_index, flash_mask, window, use_ring)
+            attn_out, new_cache = attn(ln("ln_1")(x), attn_bias, positions, cache, cache_index, flash_mask, window, use_ring, block_tables)
             x = x + attn_out
             x = x + MLP(cfg, name="mlp")(ln("ln_2")(x))
         return x, new_cache
@@ -612,6 +675,7 @@ class TransformerLM(nn.Module):
         cache: Optional[Tuple] = None,
         cache_index=None,
         cache_mask: Optional[jnp.ndarray] = None,
+        block_tables: Optional[jnp.ndarray] = None,
         start_layer: int = 0,
         stop_layer: Optional[int] = None,
         collect_hidden_at: Optional[int] = None,
@@ -627,6 +691,12 @@ class TransformerLM(nn.Module):
         - Training/prefill: cache=None, attention over the q_len itself.
         - Decode: cache=(per-layer (k,v)), cache_mask [b, kv_len] marks valid
           key slots, cache_index = write offset (static-shape dynamic slice).
+        - Paged decode: `block_tables` [b, blocks_per_slot] int32 switches the
+          per-layer cache operand to ONE shared block pool
+          ([n_blocks, block_size, h, d], see ``init_paged_cache``); cache_mask
+          and cache_index then address the row's VIRTUAL cache of kv_len =
+          blocks_per_slot * block_size — all position/bias semantics are
+          unchanged, only physical placement is table-indirect.
         - `collect_hidden_at=k` also returns the hidden state entering block k
           (the hydra branch point, reference:
           trlx/model/nn/ppo_models.py:351-368's `forward_hydra` hidden pick).
@@ -796,7 +866,7 @@ class TransformerLM(nn.Module):
             layer_window = cfg.window_size if is_local else 0
             x, layer_new_cache = block(
                 x, layer_bias, position_ids, layer_cache, cache_index,
-                flash_mask, layer_window, use_ring,
+                flash_mask, layer_window, use_ring, block_tables,
             )
             x = obs_numerics.probe_tap(f"block_{i}", x)
             if cache is not None:
@@ -904,6 +974,32 @@ def init_cache(cfg: LMConfig, batch: int, max_len: int, dtype=None):
     if cfg.kv_cache_quant:
         assert dtype is None, "kv_cache_quant caches are int8; dtype not honored"
         sshape = (batch, max_len, cfg.n_head)
+        return tuple(
+            (
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.zeros(shape, dtype=jnp.int8),
+                jnp.ones(sshape, dtype=jnp.float32),
+                jnp.ones(sshape, dtype=jnp.float32),
+            )
+            for _ in range(cfg.n_layer)
+        )
+    dtype = dtype or cfg.compute_dtype
+    zero = lambda: jnp.zeros(shape, dtype=dtype)
+    return tuple((zero(), zero()) for _ in range(cfg.n_layer))
+
+
+def init_paged_cache(cfg: LMConfig, n_blocks: int, block_size: int, dtype=None):
+    """Allocate the shared paged KV pool: per-layer (k, v) pools
+    [n_blocks, block_size, n_head, hd], or (k_i8, v_i8, k_scale, v_scale)
+    with kv_cache_quant — the paged twin of ``init_cache``. Zero/one init
+    matters: freed blocks are never scrubbed, and the trash block (index 0,
+    reserved by the engine pool) absorbs dead rows' clamped writes — masked
+    reads weight stale content by an exact softmax zero, which only stays
+    zero if the content (values AND scales) is finite."""
+    shape = (n_blocks, block_size, cfg.n_head, cfg.head_dim)
+    if cfg.kv_cache_quant:
+        assert dtype is None, "kv_cache_quant caches are int8; dtype not honored"
+        sshape = (n_blocks, block_size, cfg.n_head)
         return tuple(
             (
                 jnp.zeros(shape, dtype=jnp.int8),

@@ -167,6 +167,8 @@ class GraftScope:
         self._refill_wait_ms = []
         self._straggler = {}  # width -> [steps, ...] this window
         self._spec_accept = {}  # width -> [accept rates, ...] this window
+        self._pool_used = []  # paged-KV pool used-block fractions this window
+        self._pool_last = None  # latest paged-KV pool occupancy snapshot
         self._slot_rows = {}  # slot -> {"busy_s", "episodes", "last_width"}
         self._fences_dropped = 0
         self._pending = queue.SimpleQueue()
@@ -265,6 +267,26 @@ class GraftScope:
                 max(0.0, min(1.0, float(rate)))
             )
 
+    def record_pool(self, used, cached, free, total, frag, hits_total, saved_total):
+        """Paged-KV pool occupancy sample (one per engine sync boundary):
+        ``used`` blocks referenced by live slots, ``cached`` warm prefix
+        blocks, ``free`` unowned, out of ``total`` (incl. the trash block);
+        ``frag`` is the internal-fragmentation fraction of the used span and
+        the two totals are the engine's lifetime prefix-cache counters. The
+        last sample of a window becomes the slot-timeline pool row."""
+        with self._lock:
+            denom = max(1, int(total) - 1)  # trash block is never allocatable
+            self._pool_used.append(min(1.0, int(used) / denom))
+            self._pool_last = {
+                "used_blocks": int(used),
+                "cached_blocks": int(cached),
+                "free_blocks": int(free),
+                "total_blocks": int(total),
+                "frag_frac": float(frag),
+                "prefix_hits_total": int(hits_total),
+                "prefill_tokens_saved_total": int(saved_total),
+            }
+
     # -------------------------------------------------------------- windows
 
     def window(self):
@@ -282,6 +304,8 @@ class GraftScope:
             refill, self._refill_wait_ms = self._refill_wait_ms, []
             straggler, self._straggler = self._straggler, {}
             spec_accept, self._spec_accept = self._spec_accept, {}
+            pool_used, self._pool_used = self._pool_used, []
+            pool_last = self._pool_last
             sanitize.race_access(self, "_fences_dropped")
             fences_dropped = self._fences_dropped
         wall = max(t1w - t0w, 1e-9)
@@ -331,6 +355,9 @@ class GraftScope:
             gauges["engine/refill_wait_ms_p50"] = _pct(refill, 0.50)
             gauges["engine/refill_wait_ms_p95"] = _pct(refill, 0.95)
             gauges["engine/refill_wait_ms_max"] = max(refill)
+        if pool_used:
+            gauges["engine/pool_used_frac_p50"] = _pct(pool_used, 0.50)
+            gauges["engine/pool_used_frac_max"] = max(pool_used)
 
         top = sorted(programs.items(), key=lambda kv: -kv[1])[: self.top_k]
         record = {
@@ -345,6 +372,8 @@ class GraftScope:
             "lane_busy_s": lane_busy,
             "top_programs": [[name, round(sec, 6)] for name, sec in top],
         }
+        if pool_last is not None:
+            record["pool"] = dict(pool_last)
         with self._lock:
             self._windows.append(record)
             del self._windows[: -self.max_windows]
@@ -363,6 +392,7 @@ class GraftScope:
                 "refill_wait_ms": refill,
                 "straggler_steps": straggler,
                 "spec_accept": spec_accept,
+                "pool_used_frac": pool_used,
             }
         return gauges
 
@@ -393,6 +423,7 @@ class GraftScope:
                 "lane_busy_s": {k: round(v, 6) for k, v in self._lane_busy_s.items()},
                 "lane_gap_s": {k: round(v, 6) for k, v in self._lane_gap_s.items()},
                 "slots": slots,
+                "pool": dict(self._pool_last) if self._pool_last else None,
                 "refill_wait_total_ms": round(self._refill_wait_total_ms, 3),
                 "fences_dropped": self._fences_dropped,
                 "windows": list(self._windows),

@@ -222,6 +222,24 @@ def _kernel_plain(q_ref, k_ref, v_ref, bias_ref, o_ref,
         _finalize(o_ref, acc_ref, l_ref)
 
 
+def _paged_kernel_quant(tbl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref,
+                        o_ref, acc_ref, m_ref, l_ref, *, scale, T, bt):
+    """Block-table-indirect variant: identical online-softmax body, but the
+    K/V (and scale) operands were fetched by the BlockSpec index maps through
+    the scalar-prefetched table, so the kernel itself never sees a physical
+    block id — the virtual walk `it` is all it needs for tail masking."""
+    del tbl_ref  # consumed by the index maps, not the body
+    _kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref,
+                  acc_ref, m_ref, l_ref, scale=scale, T=T, bt=bt)
+
+
+def _paged_kernel_plain(tbl_ref, q_ref, k_ref, v_ref, bias_ref,
+                        o_ref, acc_ref, m_ref, l_ref, *, scale, T, bt):
+    del tbl_ref
+    _kernel_plain(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                  acc_ref, m_ref, l_ref, scale=scale, T=T, bt=bt)
+
+
 def decode_attn_eligible(n_head: int, head_dim: int, cache_len: int, quant: bool) -> bool:
     """Static routing: real TPU backend + a head layout the MXU/VPU tile
     cleanly (the full-[h, d] blocks are tile-LEGAL for any shape; the gate
@@ -366,6 +384,188 @@ def decode_attention(q, k_cache, v_cache, ks, vs, bias_row, *, scale,
             **common,
         )(q, k_cache, v_cache, bias3)
     return out[:, None]  # [B, 1, h, d]
+
+
+def paged_decode_eligible(
+    n_head: int, head_dim: int, block_size: int, blocks_per_slot: int, quant: bool
+) -> bool:
+    """Static routing for the block-table-indirect kernel: real TPU backend,
+    the same MXU-clean head layout as ``decode_attn_eligible``, and a
+    lane-divisible block_size (the bias block (1, 1, block_size) is the one
+    strict tile in the paged layout — a single-block table is the full-array
+    escape hatch). `quant` stays in the signature as part of the routing
+    key."""
+    if not _HAVE_PLTPU or jax.default_backend() != "tpu":
+        return False
+    if head_dim % 128 != 0 or n_head % 8 != 0:
+        return False
+    return block_size % 128 == 0 or blocks_per_slot == 1
+
+
+def paged_decode_supported(
+    n_slots: int,
+    n_blocks: int,
+    block_size: int,
+    blocks_per_slot: int,
+    h: int,
+    d: int,
+    quant: bool,
+    dtype=jnp.bfloat16,
+) -> bool:
+    """One-time cached lowering probe for the paged kernel, mirror of
+    ``decode_attn_supported``: (1) the CPU-runnable tile check over
+    tiling.paged_decode_layout — the SAME description the wrapper builds its
+    specs from; (2) on a real TPU backend, an abstract jit lower of the
+    kernel call, which additionally exercises the scalar-prefetch block
+    mapping. Any failure warns once and answers False so the model layer
+    routes through the gather-einsum path instead of dying mid-rollout."""
+    key = (
+        "paged", n_slots, n_blocks, block_size, blocks_per_slot, h, d,
+        bool(quant), jnp.dtype(dtype).name, jax.default_backend(),
+    )
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    try:
+        from trlx_tpu.ops.tiling import check_layout, paged_decode_layout
+
+        check_layout(
+            paged_decode_layout(
+                n_slots, n_blocks, block_size, blocks_per_slot, h, d, bool(quant)
+            )
+        )
+        if _HAVE_PLTPU and jax.default_backend() == "tpu":
+            s = jax.ShapeDtypeStruct
+            t_virt = blocks_per_slot * block_size
+            kv = s((n_blocks, block_size, h, d), jnp.int8 if quant else dtype)
+            args = [s((n_slots, h, d), dtype), kv, kv]
+            if quant:
+                args += [s((n_blocks, block_size, h), jnp.float32)] * 2
+            else:
+                args += [None, None]
+            args += [
+                s((n_slots, blocks_per_slot), jnp.int32),
+                s((n_slots, t_virt), jnp.float32),
+            ]
+
+            def probe(q, k, v, ks, vs, tbl, bias):
+                return paged_decode_attention(
+                    q, k, v, ks, vs, tbl, bias, scale=1.0, interpret=False
+                )
+
+            jax.jit(probe).lower(*args)
+        ok = True
+    except Exception as e:  # noqa: BLE001 — ANY probe failure must fall back
+        warnings.warn(
+            f"paged decode-attention kernel unavailable for shape "
+            f"[S={n_slots}, n_blocks={n_blocks}, bs={block_size}, "
+            f"bps={blocks_per_slot}, h={h}, d={d}, quant={quant}] — falling "
+            f"back to the gather-einsum path "
+            f"({type(e).__name__}: {str(e)[:300]})"
+        )
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok
+
+
+def paged_decode_attention(q, k_pool, v_pool, ks_pool, vs_pool, block_tables,
+                           bias_row, *, scale, interpret=None):
+    """Single-token flash-decode attention through a per-slot block table.
+
+    q: [S, h, d] (this step's query per slot). k_pool/v_pool:
+    [n_blocks, block_size, h, d] — the ONE shared physical pool, int8 when
+    ks_pool/vs_pool (per-token scales [n_blocks, block_size, h]) are given,
+    else the compute dtype. block_tables: [S, blocks_per_slot] int32 mapping
+    each slot's virtual block walk to physical pool blocks. bias_row:
+    [S, T_virt] additive fp32 mask over the slot's VIRTUAL address space
+    (T_virt = blocks_per_slot * block_size). Returns [S, 1, h, d] in q.dtype.
+
+    Same online-softmax body as ``decode_attention``; the only new machinery
+    is the scalar-prefetched table: the grid walks (slot, virtual block) and
+    the K/V/scale index maps dereference `table[s, it]` so each program DMAs
+    the slot's own physical block. T_virt is an exact multiple of block_size,
+    so the tail-mask arithmetic in the shared body is inert — raggedness and
+    dead virtual columns are entirely the bias row's job, exactly like the
+    slot-decode path."""
+    from trlx_tpu.ops.tiling import paged_decode_layout
+
+    if not _HAVE_PLTPU:  # pragma: no cover — container always ships pltpu
+        raise RuntimeError(
+            "paged_decode_attention needs jax.experimental.pallas.tpu for "
+            "PrefetchScalarGridSpec; route via paged_decode_supported first"
+        )
+    S, h, d = q.shape
+    n_blocks, bs = k_pool.shape[:2]
+    bps = block_tables.shape[1]
+    t_virt = bps * bs
+    quant = ks_pool is not None
+    interpret = _interpret_default() if interpret is None else interpret
+    grid = (S, bps)
+
+    layout = {
+        lay.name: lay
+        for lay in paged_decode_layout(S, n_blocks, bs, bps, h, d, quant)
+    }
+    # Index maps receive the grid indices first and the scalar-prefetched
+    # table ref LAST: (s, it, tbl).
+    q_spec = _vmem(layout["q"].block_shape, lambda s, it, tbl: (s, 0, 0))
+    kv_spec = _vmem(
+        layout["k_pool"].block_shape, lambda s, it, tbl: (tbl[s, it], 0, 0, 0)
+    )
+    bias_spec = _vmem(layout["bias"].block_shape, lambda s, it, tbl: (s, 0, it))
+    out_spec = _vmem(layout["out"].block_shape, lambda s, it, tbl: (s, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((S, h, d), q.dtype)
+    scratch = [
+        _scratch((h, d)),    # fp32 output accumulator
+        _scratch((h, 128)),  # running max
+        _scratch((h, 128)),  # running sum
+    ]
+    bias3 = bias_row.astype(jnp.float32)[:, None, :]  # [S, 1, T_virt]
+    tables = block_tables.astype(jnp.int32)
+    if quant:
+        sc_spec = _vmem(
+            layout["k_scale"].block_shape, lambda s, it, tbl: (tbl[s, it], 0, 0)
+        )
+        # Head-major scales: [n_blocks, bs, h] -> [n_blocks, h, bs], same
+        # trade as the non-paged wrapper (cheap XLA transpose, no in-kernel
+        # transpose).
+        ks_t = jnp.swapaxes(ks_pool, 1, 2)
+        vs_t = jnp.swapaxes(vs_pool, 1, 2)
+        in_specs = [q_spec, kv_spec, kv_spec, sc_spec, sc_spec, bias_spec]
+        kernel = functools.partial(_paged_kernel_quant, scale=scale, T=t_virt, bt=bs)
+        operands = (tables, q, k_pool, v_pool, ks_t, vs_t, bias3)
+    else:
+        in_specs = [q_spec, kv_spec, kv_spec, bias_spec]
+        kernel = functools.partial(_paged_kernel_plain, scale=scale, T=t_virt, bt=bs)
+        operands = (tables, q, k_pool, v_pool, bias3)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(*operands)
+    return out[:, None]  # [S, 1, h, d]
+
+
+def paged_slot_decode_attention(q, k_pool, v_pool, ks_pool, vs_pool,
+                                block_tables, slot_mask, *, scale,
+                                interpret=None):
+    """Slot-mask entry for the paged kernel, mirror of
+    ``slot_decode_attention``: the per-slot virtual-cache validity mask
+    ``slot_mask`` [S, T_virt] becomes the additive bias row."""
+    bias_row = jnp.where(slot_mask.astype(bool), 0.0, -1e9).astype(jnp.float32)
+    return paged_decode_attention(
+        q, k_pool, v_pool, ks_pool, vs_pool, block_tables, bias_row,
+        scale=scale, interpret=interpret,
+    )
 
 
 def slot_decode_attention(q, k_cache, v_cache, ks, vs, slot_mask, *, scale,

@@ -177,6 +177,49 @@ def spec_verify_layout(
     return layouts
 
 
+def paged_decode_layout(
+    n_slots: int,
+    n_blocks: int,
+    block_size: int,
+    blocks_per_slot: int,
+    h: int,
+    d: int,
+    quant: bool,
+) -> list:
+    """Block layouts of the block-table-indirect paged decode step
+    (trlx_tpu.ops.decode_attention.paged_decode_attention): the KV cache is
+    ONE shared pool ``[n_blocks, block_size, h, d]`` and each slot walks its
+    own ``blocks_per_slot`` virtual blocks through a per-slot block table,
+    so the grid is (slot, virtual-block) and the K/V BlockSpec index map
+    reads the scalar-prefetched table — ``(table[s, it], 0, 0, 0)`` — to
+    fetch each slot's physical block. The pool blocks' last two dims are the
+    full ``[h, d]`` (tile-legal by construction, same as
+    ``decode_block_layout``); the per-block scale planes are pre-transposed
+    to ``[n_blocks, h, block_size]`` so their trailing dim is the full
+    block_size; the bias row covers the slot's VIRTUAL address space
+    ``[n_slots, 1, blocks_per_slot * block_size]`` in block_size-wide tiles
+    — the one operand whose lane dim is a strict tile, so kernel legality
+    requires ``block_size % 128 == 0`` (or a single-block table). The
+    legality verdict is CPU-runnable via ``check_layout``; the routing gate
+    (decode_attention.paged_decode_supported) consumes this SAME description
+    plus a one-time lowering probe, so GL006 provenance and the kernel gate
+    share one source of truth."""
+    t_virt = blocks_per_slot * block_size
+    layouts = [
+        BlockLayout("q", (1, h, d), (n_slots, h, d)),
+        BlockLayout("k_pool", (1, block_size, h, d), (n_blocks, block_size, h, d)),
+        BlockLayout("v_pool", (1, block_size, h, d), (n_blocks, block_size, h, d)),
+        BlockLayout("bias", (1, 1, block_size), (n_slots, 1, t_virt)),
+        BlockLayout("out", (1, h, d), (n_slots, h, d)),
+    ]
+    if quant:
+        layouts[3:3] = [
+            BlockLayout("k_scale", (1, h, block_size), (n_blocks, h, block_size)),
+            BlockLayout("v_scale", (1, h, block_size), (n_blocks, h, block_size)),
+        ]
+    return layouts
+
+
 def flash_block_layout(BH: int, T: int, D: int, bq: int, bk: int) -> list:
     """The flash-attention forward kernel's block layouts (see
     trlx_tpu.ops.flash_attention._fwd)."""

@@ -327,6 +327,13 @@ class PPOTrainer(JaxBaseTrainer):
         # the spec-decode PR, parity-tested in tests/test_spec_decode.py.
         self.rollout_engine_enabled = bool(getattr(m, "rollout_engine", False))
         self._rollout_engine = None
+        if getattr(m, "paged_kv", False) and not self.rollout_engine_enabled:
+            raise ValueError(
+                "method.paged_kv requires method.rollout_engine: the paged "
+                "block pool and prefix cache live in the slot engine's "
+                "admission/harvest lifecycle; the chunked rollout path has "
+                "no slot reuse to page."
+            )
 
         # On-device learned reward model: a second LM + scalar head, sharded
         # with the SAME partition rules as the policy and scored inside the
@@ -512,6 +519,9 @@ class PPOTrainer(JaxBaseTrainer):
                 steps_per_sync=int(getattr(m, "engine_steps_per_sync", 8) or 8),
                 spec_decode=str(getattr(m, "spec_decode", "") or ""),
                 spec_k=int(getattr(m, "spec_k", 0) or 0),
+                paged_kv=bool(getattr(m, "paged_kv", False)),
+                kv_block_size=int(getattr(m, "kv_block_size", 128) or 128),
+                kv_pool_blocks=int(getattr(m, "kv_pool_blocks", 0) or 0),
                 dispatch_lock=self._dispatch_lock,
                 monitor=getattr(self, "_devicemon", None),
                 rng=self.next_rng(),
